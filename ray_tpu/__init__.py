@@ -1,0 +1,105 @@
+"""ray_tpu: a TPU-native distributed execution framework.
+
+Capabilities of royf/ray — dynamic tasks, actors, ownership-based
+object store, placement groups, and the library layer (data, train,
+tune, serve, rl) — re-designed for TPU hosts: jax/XLA/pjit/Pallas on
+the compute path, ICI/DCN collectives instead of NCCL/Gloo, and the
+per-task scheduling hot loop lifted onto the TPU as a batched
+feasibility/scoring kernel (see BASELINE.json north star and
+SURVEY.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+from ray_tpu._private import worker as _worker_mod
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker import is_initialized
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor
+from ray_tpu.remote_function import RemoteFunction, remote
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "get_actor", "ObjectRef", "ActorClass", "ActorHandle",
+    "RemoteFunction", "cluster_resources", "available_resources",
+    "exceptions", "nodes", "timeline",
+]
+
+
+def init(num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         resources: Optional[dict] = None,
+         object_store_memory: Optional[int] = None,
+         ignore_reinit_error: bool = True,
+         _system_config: Optional[dict] = None,
+         **kwargs):
+    """Start (or connect to) the runtime in this process."""
+    if is_initialized() and not ignore_reinit_error:
+        raise RuntimeError("ray_tpu.init() called twice")
+    return _worker_mod.init(
+        num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+        object_store_memory=object_store_memory,
+        _system_config=_system_config, **kwargs)
+
+
+def shutdown():
+    _worker_mod.shutdown()
+
+
+def put(value: Any) -> ObjectRef:
+    return _worker_mod.global_worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    w = _worker_mod.global_worker()
+    if isinstance(refs, ObjectRef):
+        return w.get([refs], timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError("get() expects an ObjectRef or a list of them")
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() got a non-ObjectRef: {type(r)}")
+    return w.get(list(refs), timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None):
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds the number of refs")
+    return _worker_mod.global_worker().wait(list(refs), num_returns, timeout)
+
+
+def kill(actor: ActorHandle):
+    from ray_tpu.actor import kill as _kill
+    _kill(actor)
+
+
+def cluster_resources() -> dict:
+    return _worker_mod.global_worker().cluster_resources()
+
+
+def available_resources() -> dict:
+    return _worker_mod.global_worker().available_resources()
+
+
+def nodes() -> List[dict]:
+    w = _worker_mod.global_worker()
+    return [
+        {
+            "NodeID": info.node_id.hex(),
+            "Alive": info.alive,
+            "Resources": dict(info.resources_total),
+        }
+        for info in w.gcs.get_all_node_info()
+    ]
+
+
+def timeline() -> List[dict]:
+    """Chrome-trace events for completed tasks (reference: ray timeline)."""
+    from ray_tpu._private.events import get_task_events
+    return get_task_events()

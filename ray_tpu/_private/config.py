@@ -1,0 +1,153 @@
+"""Two-tier config system.
+
+Mirrors the reference's ``RAY_CONFIG(type, name, default)`` macro table
+(royf/ray ``src/ray/common/ray_config_def.h`` [UNVERIFIED — mount empty,
+SURVEY.md §0]): a flat registry of typed knobs, each overridable via a
+``RAY_TPU_<name>`` environment variable per-process and via the
+``_system_config`` dict passed to ``ray_tpu.init`` cluster-wide.
+
+Python library-layer configs (ScalingConfig, DataContext, ...) live with
+their libraries; this module is the runtime-core tier only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Dict
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+
+class Config:
+    """Singleton runtime config. Access knobs as attributes."""
+
+    _DEFS: Dict[str, tuple] = {}  # name -> (type, default, doc)
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._load_env()
+
+    @classmethod
+    def define(cls, name: str, typ: type, default: Any, doc: str = ""):
+        cls._DEFS[name] = (typ, default, doc)
+
+    def _load_env(self):
+        for name, (typ, default, _doc) in self._DEFS.items():
+            env = os.environ.get(_ENV_PREFIX + name)
+            if env is not None:
+                self._values[name] = _PARSERS[typ](env)
+            else:
+                self._values[name] = default
+
+    def apply_system_config(self, system_config: Dict[str, Any]):
+        """Cluster-wide overrides (the ``_system_config`` JSON of the
+        reference). Env vars still win: they were applied per-process."""
+        with self._lock:
+            for name, value in system_config.items():
+                if name not in self._DEFS:
+                    raise ValueError(f"Unknown system config key: {name}")
+                if _ENV_PREFIX + name in os.environ:
+                    continue
+                typ = self._DEFS[name][0]
+                if isinstance(value, str) and typ is not str:
+                    value = _PARSERS[typ](value)
+                self._values[name] = typ(value)
+
+    def serialize(self) -> str:
+        return json.dumps(self._values)
+
+    def load_serialized(self, payload: str):
+        with self._lock:
+            self._values.update(json.loads(payload))
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def reset(self):
+        with self._lock:
+            self._values.clear()
+            self._load_env()
+
+
+_D = Config.define
+
+# --- scheduling (reference: scheduler_* knobs) ---
+_D("scheduler_spread_threshold", float, 0.5,
+   "Critical-resource utilization above which the hybrid policy stops "
+   "packing onto the local node and spreads by least-utilization.")
+_D("scheduler_top_k_fraction", float, 0.2,
+   "Fraction of feasible nodes considered in the top-k tie-break.")
+_D("scheduler_top_k_absolute", int, 1,
+   "Minimum top-k regardless of fraction.")
+_D("tpu_scheduler_batch_size", int, 512,
+   "Pending tasks batched per TPU scheduling-kernel invocation.")
+_D("tpu_scheduler_conflict_rounds", int, 8,
+   "Bounded conflict-resolution iterations in the batched assignment kernel.")
+_D("use_tpu_scheduler", bool, False,
+   "Select the TPU policy in the ISchedulingPolicy registry.")
+
+# --- core worker / tasks ---
+_D("task_max_retries", int, 3, "Default retries for normal tasks.")
+_D("actor_max_restarts", int, 0, "Default actor restart count.")
+_D("max_direct_call_object_size", int, 100 * 1024,
+   "Results at or below this size are inlined in the reply instead of "
+   "going through the shared-memory store.")
+_D("worker_lease_timeout_ms", int, 30000, "Lease RPC timeout.")
+_D("task_events_max_buffer", int, 100000,
+   "Ring-buffer capacity of the per-worker task event stream.")
+
+# --- object store ---
+_D("object_store_memory_bytes", int, 512 * 1024 * 1024,
+   "Per-node shared-memory store capacity.")
+_D("object_spilling_threshold", float, 0.8,
+   "Fraction of store capacity above which primary copies spill to disk.")
+_D("object_store_fallback_directory", str, "",
+   "Spill directory; empty = <session_dir>/spill.")
+_D("object_chunk_size_bytes", int, 5 * 1024 * 1024,
+   "Chunk size for node-to-node object transfer.")
+
+# --- worker pool ---
+_D("worker_pool_prestart", int, 0, "Workers to pre-fork at init.")
+_D("worker_pool_max_idle_s", float, 60.0, "Idle worker reap time.")
+_D("worker_start_timeout_s", float, 60.0, "Worker process start timeout.")
+
+# --- gcs / health ---
+_D("health_check_period_ms", int, 1000, "GCS -> node health ping period.")
+_D("health_check_failure_threshold", int, 5,
+   "Missed pings before a node is declared dead.")
+_D("gcs_pubsub_poll_timeout_ms", int, 10000, "Long-poll timeout.")
+
+# --- logging / events ---
+_D("event_log_enabled", bool, True, "Structured event log to session dir.")
+_D("log_level", str, "INFO", "Runtime log level.")
+
+
+_global_config: Config | None = None
+_global_lock = threading.Lock()
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        with _global_lock:
+            if _global_config is None:
+                _global_config = Config()
+    return _global_config

@@ -1,0 +1,69 @@
+"""Unix-socket hub where spawned workers register back.
+
+Reference analog: raylet's local socket that workers connect to on
+startup (``RegisterClient``) [UNVERIFIED — mount empty, SURVEY.md §0].
+Workers are plain ``exec``'d processes — never multiprocessing children
+— so nothing about the driver's ``__main__`` or jax/TPU state leaks
+into them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from multiprocessing.connection import Connection, Listener
+from typing import Callable, Dict
+
+
+class ConnectionHub:
+    def __init__(self, session: str):
+        self._dir = os.path.join("/tmp", f"rtpu_{session}")
+        os.makedirs(self._dir, exist_ok=True)
+        self.address = os.path.join(self._dir, "workers.sock")
+        self._listener = Listener(self.address, "AF_UNIX")
+        self._pending: Dict[str, Callable[[Connection, int], None]] = {}
+        self._lock = threading.Lock()
+        self._shutdown = False
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="rtpu-hub")
+        self._thread.start()
+
+    def expect(self, token: str,
+               on_register: Callable[[Connection, int], None]) -> None:
+        with self._lock:
+            self._pending[token] = on_register
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                if self._shutdown:
+                    return
+                continue
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                conn.close()
+                continue
+            if not (isinstance(msg, tuple) and msg[0] == "register"):
+                conn.close()
+                continue
+            _, token, pid = msg
+            with self._lock:
+                cb = self._pending.pop(token, None)
+            if cb is None:
+                conn.close()
+            else:
+                cb(conn, pid)
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        try:
+            os.unlink(self.address)
+        except OSError:
+            pass

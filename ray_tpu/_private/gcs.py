@@ -1,0 +1,156 @@
+"""GCS-lite: authoritative cluster state for the single-host slice.
+
+Reference: ``src/ray/gcs/gcs_server/`` — GcsNodeManager, GcsActorManager,
+GcsPlacementGroupManager, InternalKVManager, GcsPublisher [UNVERIFIED —
+mount empty, SURVEY.md §0]. This is the in-process slice of those
+services; the seams (tables keyed by binary ids, a pub/sub channel per
+table, a KV namespace) match so a networked GCS can replace it without
+touching callers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
+
+
+class Publisher:
+    """Minimal in-process pub/sub (reference: src/ray/pubsub/)."""
+
+    def __init__(self):
+        self._subs: Dict[str, List[Callable]] = defaultdict(list)
+        self._lock = threading.Lock()
+
+    def subscribe(self, channel: str, callback: Callable) -> None:
+        with self._lock:
+            self._subs[channel].append(callback)
+
+    def publish(self, channel: str, message) -> None:
+        with self._lock:
+            subs = list(self._subs.get(channel, ()))
+        for cb in subs:
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: Optional[str]
+    namespace: str
+    state: str = "PENDING"   # PENDING|ALIVE|RESTARTING|DEAD
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: str = ""
+    creation_spec: object = None
+    class_name: str = ""
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    resources_total: Dict[str, float] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    start_time: float = field(default_factory=time.time)
+
+
+class GcsLite:
+    def __init__(self):
+        self.publisher = Publisher()
+        self._lock = threading.RLock()
+        self._nodes: Dict[NodeID, NodeInfo] = {}
+        self._actors: Dict[ActorID, ActorInfo] = {}
+        self._named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self._kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)
+        self._job_counter = 0
+
+    # -- jobs --------------------------------------------------------------
+
+    def next_job_id(self) -> int:
+        with self._lock:
+            self._job_counter += 1
+            return self._job_counter
+
+    # -- nodes -------------------------------------------------------------
+
+    def register_node(self, info: NodeInfo) -> None:
+        with self._lock:
+            self._nodes[info.node_id] = info
+        self.publisher.publish("NODE", ("ADDED", info))
+
+    def remove_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info:
+                info.alive = False
+        self.publisher.publish("NODE", ("REMOVED", node_id))
+
+    def get_all_node_info(self) -> List[NodeInfo]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    # -- actors ------------------------------------------------------------
+
+    def register_actor(self, info: ActorInfo) -> None:
+        with self._lock:
+            self._actors[info.actor_id] = info
+            if info.name:
+                key = (info.namespace, info.name)
+                if key in self._named_actors:
+                    existing = self._actors.get(self._named_actors[key])
+                    if existing is not None and existing.state != "DEAD":
+                        raise ValueError(
+                            f"actor name {info.name!r} already taken in "
+                            f"namespace {info.namespace!r}")
+                self._named_actors[key] = info.actor_id
+
+    def update_actor_state(self, actor_id: ActorID, state: str,
+                           death_cause: str = "") -> None:
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return
+            info.state = state
+            if death_cause:
+                info.death_cause = death_cause
+        self.publisher.publish("ACTOR", (state, actor_id))
+
+    def get_actor_info(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        with self._lock:
+            return self._actors.get(actor_id)
+
+    def get_named_actor(self, name: str, namespace: str
+                        ) -> Optional[ActorInfo]:
+        with self._lock:
+            aid = self._named_actors.get((namespace, name))
+            return self._actors.get(aid) if aid else None
+
+    def list_actors(self) -> List[ActorInfo]:
+        with self._lock:
+            return list(self._actors.values())
+
+    # -- internal KV (reference: InternalKVManager) ------------------------
+
+    def kv_put(self, key: bytes, value: bytes, namespace: str = "") -> None:
+        with self._lock:
+            self._kv[namespace][key] = value
+
+    def kv_get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
+        with self._lock:
+            return self._kv[namespace].get(key)
+
+    def kv_del(self, key: bytes, namespace: str = "") -> None:
+        with self._lock:
+            self._kv[namespace].pop(key, None)
+
+    def kv_keys(self, prefix: bytes, namespace: str = "") -> List[bytes]:
+        with self._lock:
+            return [k for k in self._kv[namespace] if k.startswith(prefix)]

@@ -1,0 +1,568 @@
+"""Node manager group: logical raylets, dependency resolution, the
+cluster scheduling loop, and worker IO routing.
+
+Reference analogs [UNVERIFIED — mount empty, SURVEY.md §0]:
+- ``src/ray/raylet/node_manager.cc`` (per-node manager)
+- ``src/ray/raylet/scheduling/cluster_task_manager.cc`` (queues +
+  schedule loop), ``local_task_manager.cc`` (dispatch to workers)
+- ``src/ray/raylet/dependency_manager.cc``
+
+Topology note: like the reference's test clusters (N raylets as
+processes on one machine), logical nodes here are N raylet objects in
+the host process, each with its own worker pool and resource ledger,
+scheduled against a shared ``ClusterResourceManager``. The scheduling
+decision/dispatch seam is identical to the distributed one, so the
+policy layer (including the TPU kernel policy) cannot tell the
+difference; cross-host raylets plug in at the `Raylet` interface.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_store import MemoryStore, ShmStore
+from ray_tpu._private.scheduler.policy import (
+    ISchedulingPolicy,
+    SchedulingRequest,
+)
+from ray_tpu._private.scheduler.resources import (
+    ClusterResourceManager,
+    NodeResources,
+)
+from ray_tpu._private.task_spec import TaskSpec, TaskType
+from ray_tpu._private.worker_pool import BaseWorker, ProcessWorker, WorkerPool
+from ray_tpu.exceptions import WorkerCrashedError
+
+logger = logging.getLogger(__name__)
+
+
+class DependencyManager:
+    """Tracks which queued tasks wait on which objects."""
+
+    def __init__(self):
+        self._waiting_on: Dict[ObjectID, Set[TaskID]] = defaultdict(set)
+        self._remaining: Dict[TaskID, int] = {}
+        self._lock = threading.Lock()
+
+    def add_task(self, task_id: TaskID, deps: List[ObjectID],
+                 is_available: Callable[[ObjectID], bool]) -> bool:
+        """Register; returns True if already ready."""
+        with self._lock:
+            missing = [d for d in deps if not is_available(d)]
+            if not missing:
+                return True
+            self._remaining[task_id] = len(missing)
+            for d in missing:
+                self._waiting_on[d].add(task_id)
+            return False
+
+    def on_object_available(self, object_id: ObjectID) -> List[TaskID]:
+        with self._lock:
+            ready = []
+            for tid in self._waiting_on.pop(object_id, ()):  # noqa: B020
+                self._remaining[tid] -= 1
+                if self._remaining[tid] == 0:
+                    del self._remaining[tid]
+                    ready.append(tid)
+            return ready
+
+    def cancel_task(self, task_id: TaskID) -> None:
+        with self._lock:
+            self._remaining.pop(task_id, None)
+            for waiters in self._waiting_on.values():
+                waiters.discard(task_id)
+
+
+class RunningTask:
+    __slots__ = ("spec", "node_id", "worker", "resources")
+
+    def __init__(self, spec: TaskSpec, node_id: NodeID, worker: BaseWorker,
+                 resources: Dict[str, float]):
+        self.spec = spec
+        self.node_id = node_id
+        self.worker = worker
+        self.resources = resources
+
+
+class Raylet:
+    """One logical node: resource ledger + worker pool + dispatch queue."""
+
+    def __init__(self, node_id: NodeID, resources: NodeResources,
+                 session: str, hub, reply_handler, on_worker_ready,
+                 labels=None, max_process_workers: int = 8):
+        self.node_id = node_id
+        self.resources = resources
+        if labels:
+            self.resources.labels.update(labels)
+        self.worker_pool = WorkerPool(session, hub, reply_handler,
+                                      on_worker_ready,
+                                      max_process_workers=max_process_workers)
+        self.dispatch_queue: deque = deque()
+        self.alive = True
+
+
+class NodeManagerGroup:
+    """Owns all logical raylets plus the scheduling/IO machinery."""
+
+    def __init__(self, session: str, memory_store: MemoryStore,
+                 shm_store: ShmStore, policy: ISchedulingPolicy,
+                 complete_task_cb, function_blob_provider,
+                 driver_node_resources: NodeResources,
+                 max_process_workers: int = 8):
+        self._session = session
+        self._memory_store = memory_store
+        self._shm_store = shm_store
+        self._policy = policy
+        self._complete_task = complete_task_cb  # (task_id, results, err_blob, sys_err)
+        self._function_blob = function_blob_provider  # fid -> bytes
+        self._max_process_workers = max_process_workers
+
+        self.cluster_resources = ClusterResourceManager()
+        self.dependency_manager = DependencyManager()
+
+        self._lock = threading.RLock()
+        self._raylets: Dict[NodeID, Raylet] = {}
+        self._waiting: Dict[TaskID, TaskSpec] = {}
+        self._to_schedule: deque = deque()
+        self._infeasible: Dict[TaskID, TaskSpec] = {}
+        self._running: Dict[TaskID, RunningTask] = {}
+        self._actor_workers: Dict[ActorID, Tuple[NodeID, BaseWorker, dict]] = {}
+        self._actor_death_cb: Optional[Callable] = None
+
+        self._wake = threading.Event()
+        self._shutdown = False
+
+        from ray_tpu._private.connection_hub import ConnectionHub
+        self.hub = ConnectionHub(session)
+
+        self.head_node_id = NodeID.from_random()
+        self.add_node(self.head_node_id, driver_node_resources)
+
+        self._sched_thread = threading.Thread(
+            target=self._scheduling_loop, daemon=True, name="rtpu-sched")
+        self._io_thread = threading.Thread(
+            target=self._io_loop, daemon=True, name="rtpu-io")
+        self._sched_thread.start()
+        self._io_thread.start()
+
+    # -- cluster membership ------------------------------------------------
+
+    def add_node(self, node_id: NodeID, resources: NodeResources,
+                 labels: Optional[dict] = None) -> Raylet:
+        raylet = Raylet(node_id, resources, self._session, self.hub,
+                        self._on_inproc_reply, self._wake.set, labels,
+                        self._max_process_workers)
+        with self._lock:
+            self._raylets[node_id] = raylet
+        self.cluster_resources.add_or_update_node(node_id, resources)
+        self._wake.set()
+        return raylet
+
+    def remove_node(self, node_id: NodeID) -> None:
+        """Simulate node death: fail running tasks, drop resources."""
+        with self._lock:
+            raylet = self._raylets.pop(node_id, None)
+            if raylet is None:
+                return
+            raylet.alive = False
+            dead_tasks = [tid for tid, rt in self._running.items()
+                          if rt.node_id == node_id]
+            # Tasks scheduled to this node but not yet leased go back to
+            # the cluster queue for rescheduling elsewhere.
+            requeue = list(raylet.dispatch_queue)
+            raylet.dispatch_queue.clear()
+            self._to_schedule.extend(requeue)
+        self.cluster_resources.remove_node(node_id)
+        for tid in dead_tasks:
+            self._fail_running(tid, WorkerCrashedError(
+                f"node {node_id.hex()[:8]} died"))
+        raylet.worker_pool.shutdown()
+        self._wake.set()
+
+    def nodes(self) -> List[NodeID]:
+        with self._lock:
+            return list(self._raylets)
+
+    # -- submission --------------------------------------------------------
+
+    def submit_task(self, spec: TaskSpec) -> None:
+        deps = spec.dependencies()
+        ready = self.dependency_manager.add_task(
+            spec.task_id, deps, self._object_available)
+        with self._lock:
+            if ready:
+                self._to_schedule.append(spec)
+            else:
+                self._waiting[spec.task_id] = spec
+        self._wake.set()
+
+    def _object_available(self, oid: ObjectID) -> bool:
+        return self._memory_store.contains(oid)
+
+    def on_object_available(self, object_id: ObjectID) -> None:
+        ready = self.dependency_manager.on_object_available(object_id)
+        if not ready:
+            return
+        with self._lock:
+            for tid in ready:
+                spec = self._waiting.pop(tid, None)
+                if spec is not None:
+                    self._to_schedule.append(spec)
+        self._wake.set()
+
+    # -- actor task routing ------------------------------------------------
+
+    def register_actor_worker(self, actor_id: ActorID, node_id: NodeID,
+                              worker: BaseWorker, resources: dict) -> None:
+        with self._lock:
+            self._actor_workers[actor_id] = (node_id, worker, resources)
+
+    def set_actor_death_callback(self, cb: Callable) -> None:
+        self._actor_death_cb = cb
+
+    def actor_worker(self, actor_id: ActorID) -> Optional[BaseWorker]:
+        with self._lock:
+            entry = self._actor_workers.get(actor_id)
+            return entry[1] if entry else None
+
+    def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec,
+                          payload: dict) -> bool:
+        with self._lock:
+            entry = self._actor_workers.get(actor_id)
+            if entry is None or not entry[1].alive:
+                return False
+            _, worker, _ = entry
+            self._running[spec.task_id] = RunningTask(
+                spec, entry[0], worker, {})
+        worker.send(("exec_actor", payload))
+        from ray_tpu._private import events
+        events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
+                      worker=worker.worker_id.hex()[:8])
+        return True
+
+    def release_actor(self, actor_id: ActorID, kill_worker: bool = True
+                      ) -> None:
+        with self._lock:
+            entry = self._actor_workers.pop(actor_id, None)
+        if entry is None:
+            return
+        node_id, worker, resources = entry
+        if kill_worker:
+            worker.send(("shutdown",))
+            worker.kill()
+            with self._lock:
+                raylet = self._raylets.get(node_id)
+            if raylet is not None:
+                raylet.worker_pool.remove_worker(worker)
+        self.cluster_resources.free(node_id, resources)
+        self._wake.set()
+
+    # -- scheduling loop ---------------------------------------------------
+
+    def _scheduling_loop(self) -> None:
+        cfg = get_config()
+        batch_limit = cfg.tpu_scheduler_batch_size
+        while not self._shutdown:
+            self._wake.wait(timeout=0.1)
+            self._wake.clear()
+            try:
+                self._schedule_once(batch_limit)
+                self._dispatch_all()
+            except Exception:
+                logger.exception("scheduling loop error")
+
+    def _schedule_once(self, batch_limit: int) -> None:
+        with self._lock:
+            batch: List[TaskSpec] = []
+            while self._to_schedule and len(batch) < batch_limit:
+                batch.append(self._to_schedule.popleft())
+        if not batch:
+            return
+        requests = [
+            SchedulingRequest(
+                demand=spec.resources,
+                preferred_node=self.head_node_id,
+                strategy=spec.scheduling_strategy,
+            )
+            for spec in batch
+        ]
+        results = self._policy.schedule_batch(self.cluster_resources, requests)
+        retry: List[TaskSpec] = []
+        for spec, res in zip(batch, results):
+            if res.node_id is None:
+                if res.is_infeasible:
+                    with self._lock:
+                        self._infeasible[spec.task_id] = spec
+                    logger.warning(
+                        "task %s is infeasible: demand=%s",
+                        spec.repr_name(), spec.resources)
+                else:
+                    retry.append(spec)
+                continue
+            if not self.cluster_resources.allocate(res.node_id,
+                                                   spec.resources):
+                retry.append(spec)
+                continue
+            with self._lock:
+                raylet = self._raylets.get(res.node_id)
+                if raylet is None or not raylet.alive:
+                    self.cluster_resources.free(res.node_id, spec.resources)
+                    retry.append(spec)
+                    continue
+                raylet.dispatch_queue.append(spec)
+        if retry:
+            with self._lock:
+                self._to_schedule.extend(retry)
+
+    def recheck_infeasible(self) -> None:
+        with self._lock:
+            specs = list(self._infeasible.values())
+            self._infeasible.clear()
+            self._to_schedule.extend(specs)
+        self._wake.set()
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch_all(self) -> None:
+        with self._lock:
+            raylets = list(self._raylets.values())
+        for raylet in raylets:
+            self._dispatch_node(raylet)
+
+    def _dispatch_node(self, raylet: Raylet) -> None:
+        while True:
+            with self._lock:
+                if not raylet.dispatch_queue or not raylet.alive:
+                    return
+                spec = raylet.dispatch_queue.popleft()
+            dedicated = spec.task_type == TaskType.ACTOR_CREATION_TASK
+            worker = raylet.worker_pool.pop_worker(spec.resources, dedicated)
+            if worker is None:
+                with self._lock:
+                    raylet.dispatch_queue.appendleft(spec)
+                return
+            err = self._send_task(raylet, worker, spec)
+            if err is not None:
+                raylet.worker_pool.push_worker(worker)
+                self.cluster_resources.free(raylet.node_id, spec.resources)
+                if isinstance(err, _DependencyError):
+                    # Upstream task failed: propagate its error verbatim,
+                    # never retry the dependent (reference semantics).
+                    self._complete_task(spec.task_id, [], err.entry.data, None)
+                else:
+                    self._complete_task(spec.task_id, [], None, err)
+
+    def _send_task(self, raylet: Raylet, worker: BaseWorker,
+                   spec: TaskSpec) -> Optional[BaseException]:
+        """Build the payload (resolving args from the owner's stores) and
+        ship it. Returns an error to fail the task without executing."""
+        arg_descs = []
+        for arg in spec.args:
+            if arg.object_id is None:
+                arg_descs.append(("v", arg.inline_blob))
+                continue
+            entry = self._memory_store.get(arg.object_id, timeout=0)
+            if entry.kind == "err":
+                # dependency failed -> propagate without executing
+                with self._lock:
+                    self._running.pop(spec.task_id, None)
+                return _DependencyError(entry)
+            if entry.kind == "blob":
+                arg_descs.append(("v", entry.data))
+            else:  # shm
+                name, size = entry.data
+                arg_descs.append(("shm", arg.object_id.binary(), name, size))
+        payload = {
+            "type": ("create_actor"
+                     if spec.task_type == TaskType.ACTOR_CREATION_TASK
+                     else "exec"),
+            "task_id": spec.task_id.binary(),
+            "function_id": spec.function.function_id,
+            "args": arg_descs,
+            "kwargs_keys": spec.kwargs_keys,
+            "num_returns": spec.num_returns,
+            "return_ids": [o.binary() for o in spec.return_ids],
+            "name": spec.repr_name(),
+        }
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            payload["actor_id"] = spec.actor_creation_id.binary()
+        try:
+            raylet.worker_pool.ensure_function(
+                worker, spec.function.function_id,
+                lambda: self._function_blob(spec.function.function_id))
+            with self._lock:
+                self._running[spec.task_id] = RunningTask(
+                    spec, raylet.node_id, worker, dict(spec.resources))
+            worker.send(("exec" if payload["type"] == "exec"
+                         else "create_actor", payload))
+            from ray_tpu._private import events
+            events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
+                          worker=worker.worker_id.hex()[:8])
+        except Exception as e:  # worker pipe broken
+            with self._lock:
+                self._running.pop(spec.task_id, None)
+            return WorkerCrashedError(str(e))
+        return None
+
+    # -- replies -----------------------------------------------------------
+
+    def _on_inproc_reply(self, worker: BaseWorker, reply: tuple) -> None:
+        try:
+            self._handle_reply(worker, reply)
+        except Exception:
+            logger.exception("error handling in-process worker reply")
+
+    def _handle_reply(self, worker: BaseWorker, reply: tuple) -> None:
+        op = reply[0]
+        if op == "done":
+            _, task_id_b, results, err_blob = reply
+            task_id = TaskID(task_id_b)
+            with self._lock:
+                rt = self._running.pop(task_id, None)
+            if rt is None:
+                return
+            if not worker.is_actor_worker:
+                with self._lock:
+                    raylet = self._raylets.get(rt.node_id)
+                if raylet is not None:
+                    raylet.worker_pool.push_worker(worker)
+                self.cluster_resources.free(rt.node_id, rt.resources)
+                self._wake.set()
+            self._complete_task(task_id, results, err_blob, None)
+        elif op == "actor_ready":
+            _, actor_id_b, err_blob = reply
+            task_id = None
+            with self._lock:
+                for tid, rt in self._running.items():
+                    if (rt.spec.task_type == TaskType.ACTOR_CREATION_TASK
+                            and rt.spec.actor_creation_id.binary()
+                            == actor_id_b):
+                        task_id = tid
+                        break
+                rt = self._running.pop(task_id, None) if task_id else None
+            if rt is None:
+                return
+            if err_blob is not None:
+                # creation failed: release worker + resources
+                with self._lock:
+                    raylet = self._raylets.get(rt.node_id)
+                if raylet is not None:
+                    raylet.worker_pool.remove_worker(worker)
+                    worker.send(("shutdown",))
+                self.cluster_resources.free(rt.node_id, rt.resources)
+                self._complete_task(task_id, [], err_blob, None)
+            else:
+                self.register_actor_worker(
+                    ActorID(actor_id_b), rt.node_id, worker, rt.resources)
+                self._complete_task(task_id, [], None, None)
+
+    def _io_loop(self) -> None:
+        from multiprocessing.connection import wait as conn_wait
+        while not self._shutdown:
+            conns = []
+            with self._lock:
+                raylets = list(self._raylets.values())
+            conn_to_raylet = {}
+            for raylet in raylets:
+                for c in raylet.worker_pool.process_connections():
+                    conns.append(c)
+                    conn_to_raylet[id(c)] = raylet
+            if not conns:
+                time.sleep(0.01)
+                continue
+            for c in conn_wait(conns, timeout=0.1):
+                raylet = conn_to_raylet[id(c)]
+                worker = raylet.worker_pool.worker_by_conn(c)
+                if worker is None:
+                    continue
+                try:
+                    msg = c.recv()
+                except (EOFError, OSError):
+                    try:
+                        self._on_worker_death(raylet, worker)
+                    except Exception:
+                        logger.exception("error handling worker death")
+                    continue
+                try:
+                    if msg[0] == "ready":
+                        worker.ready = True
+                    elif msg[0] == "pong":
+                        pass
+                    else:
+                        self._handle_reply(worker, msg)
+                except Exception:
+                    # Never let a completion error kill the IO thread —
+                    # that would orphan every process worker.
+                    logger.exception("error handling worker reply")
+
+    def _on_worker_death(self, raylet: Raylet, worker: ProcessWorker) -> None:
+        raylet.worker_pool.remove_worker(worker)
+        worker.kill()
+        dead: List[TaskID] = []
+        dead_actor: Optional[ActorID] = None
+        with self._lock:
+            for tid, rt in self._running.items():
+                if rt.worker is worker:
+                    dead.append(tid)
+            for aid, (nid, w, res) in list(self._actor_workers.items()):
+                if w is worker:
+                    dead_actor = aid
+        for tid in dead:
+            self._fail_running(tid, WorkerCrashedError(
+                "worker process died while executing task"))
+        if dead_actor is not None:
+            with self._lock:
+                entry = self._actor_workers.pop(dead_actor, None)
+            if entry is not None:
+                nid, _, res = entry
+                self.cluster_resources.free(nid, res)
+                if self._actor_death_cb is not None:
+                    self._actor_death_cb(dead_actor)
+        self._wake.set()
+
+    def _fail_running(self, task_id: TaskID, err: BaseException) -> None:
+        with self._lock:
+            rt = self._running.pop(task_id, None)
+        if rt is None:
+            return
+        if not rt.worker.is_actor_worker and rt.resources:
+            self.cluster_resources.free(rt.node_id, rt.resources)
+        self._complete_task(task_id, [], None, err)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        self._wake.set()
+        with self._lock:
+            raylets = list(self._raylets.values())
+        for raylet in raylets:
+            raylet.worker_pool.shutdown()
+        self._sched_thread.join(timeout=2)
+        self._io_thread.join(timeout=2)
+        self.hub.shutdown()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "nodes": len(self._raylets),
+                "to_schedule": len(self._to_schedule),
+                "waiting_deps": len(self._waiting),
+                "running": len(self._running),
+                "infeasible": len(self._infeasible),
+                "actors": len(self._actor_workers),
+            }
+
+
+class _DependencyError(Exception):
+    """Internal: carries a failed dependency's error entry."""
+
+    def __init__(self, entry):
+        self.entry = entry
+        super().__init__("dependency failed")

@@ -1,0 +1,391 @@
+"""Per-node object plane: shared-memory store + in-process memory store.
+
+TPU-native re-design of the reference's object plane (royf/ray
+``src/ray/object_manager/plasma/`` + core-worker memory store
+[UNVERIFIED — mount empty, SURVEY.md §0]):
+
+- ``MemoryStore``: per-process store for small / inlined results (the
+  reference inlines results <= ``max_direct_call_object_size`` in the
+  task reply rather than round-tripping shared memory).
+- ``ShmStore``: per-node store of sealed, immutable blobs in POSIX
+  shared memory. One segment per object (the reference carves one big
+  mmap with dlmalloc; per-object segments give the same zero-copy
+  mmap reads with far less allocator machinery, and the kernel already
+  does the page accounting). Readers in other processes attach by
+  deterministic name and deserialize aliasing the mapping.
+- Spilling: above a capacity threshold, least-recently-used sealed
+  primaries are written to the session spill directory and their
+  segments unlinked; access restores them (reference:
+  ``LocalObjectManager::SpillObjects``).
+
+HBM tier: device values (``jax.Array``) are not forced through host
+shm. ``ray_tpu.put`` of a jax array stores the host representation
+only on demand; see ``device_object.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict
+from multiprocessing import shared_memory
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.ids import ObjectID
+
+# Silence the resource tracker for segments we manage ourselves: every
+# attach would otherwise register the segment for (double) cleanup.
+try:  # Python >= 3.13 (and some 3.12 builds) support track=False
+    _probe = shared_memory.SharedMemory(
+        name=f"rtpu_probe_{os.getpid()}", create=True, size=8, track=False)
+    _probe.close()
+    _probe.unlink()
+    _TRACK_KW = {"track": False}
+except TypeError:  # pragma: no cover - older Python
+    _TRACK_KW = {}
+    from multiprocessing import resource_tracker
+
+    _orig_register = resource_tracker.register
+    _orig_unregister = resource_tracker.unregister
+
+    def _register(name, rtype):  # noqa: ANN001
+        if rtype == "shared_memory" and "rtpu_" in name:
+            return
+        _orig_register(name, rtype)
+
+    def _unregister(name, rtype):  # noqa: ANN001
+        if rtype == "shared_memory" and "rtpu_" in name:
+            return
+        _orig_unregister(name, rtype)
+
+    resource_tracker.register = _register
+    resource_tracker.unregister = _unregister
+
+
+def _segment_name(session: str, object_id: ObjectID) -> str:
+    # Full hex: an ObjectID's uniqueness lives in its TRAILING bytes
+    # (task randomness + return index); any prefix truncation collides.
+    return f"rtpu_{session}_{object_id.hex()}"
+
+
+def create_segment(name: str, size: int) -> shared_memory.SharedMemory:
+    """Create an untracked segment (writer side). Untracked matters:
+    the stdlib resource tracker would unlink segments when the creating
+    worker process exits, destroying objects that outlive their
+    creator — exactly what a task result does."""
+    return shared_memory.SharedMemory(name=name, create=True,
+                                      size=max(size, 1), **_TRACK_KW)
+
+
+def attach_segment(name: str) -> shared_memory.SharedMemory:
+    return shared_memory.SharedMemory(name=name, create=False, **_TRACK_KW)
+
+
+class ObjectStoreFullError(Exception):
+    pass
+
+
+class ShmStore:
+    """Node-local shared-memory store (create/seal/get/free/spill).
+
+    Single-writer (the node's core), many readers (`ShmClient`).
+    """
+
+    def __init__(self, session: str, capacity_bytes: int,
+                 spill_dir: Optional[str] = None,
+                 spill_threshold: float = 0.8):
+        self._session = session
+        self._capacity = capacity_bytes
+        self._spill_threshold = spill_threshold
+        self._spill_dir = spill_dir
+        self._lock = threading.Lock()
+        self._segments: Dict[ObjectID, shared_memory.SharedMemory] = {}
+        self._sizes: Dict[ObjectID, int] = {}
+        self._sealed: "OrderedDict[ObjectID, float]" = OrderedDict()  # LRU
+        self._spilled: Dict[ObjectID, Tuple[str, int]] = {}  # path, size
+        self._used = 0
+        self._zombies: List[shared_memory.SharedMemory] = []
+        self.num_spilled = 0
+        self.num_restored = 0
+
+    def _close_or_defer(self, seg: shared_memory.SharedMemory) -> None:
+        """Close a segment's mapping; if zero-copy views still alias it
+        (BufferError: exported pointers), defer — the unlinked mapping
+        stays valid until the last reader view dies, which is exactly
+        the pin-until-released semantics readers rely on."""
+        try:
+            seg.close()
+        except BufferError:
+            self._zombies.append(seg)
+
+    def _drain_zombies(self) -> None:
+        still = []
+        for seg in self._zombies:
+            try:
+                seg.close()
+            except BufferError:
+                still.append(seg)
+        self._zombies = still
+
+    # -- write path --------------------------------------------------------
+
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        with self._lock:
+            if object_id in self._segments or object_id in self._spilled:
+                raise ValueError(f"object {object_id} already exists")
+            self._ensure_capacity(size)
+            seg = shared_memory.SharedMemory(
+                name=_segment_name(self._session, object_id),
+                create=True, size=max(size, 1), **_TRACK_KW)
+            self._segments[object_id] = seg
+            self._sizes[object_id] = size
+            self._used += size
+            return seg.buf[:size]
+
+    def seal(self, object_id: ObjectID) -> None:
+        with self._lock:
+            if object_id not in self._segments:
+                raise KeyError(object_id)
+            self._sealed[object_id] = time.monotonic()
+
+    def put_blob(self, object_id: ObjectID, blob: bytes) -> None:
+        buf = self.create(object_id, len(blob))
+        buf[:] = blob
+        self.seal(object_id)
+
+    def adopt(self, object_id: ObjectID, size: int) -> None:
+        """Take ownership of a segment a worker process already created
+        and sealed under the deterministic name for ``object_id`` (the
+        write path of remote task results — the worker writes, the node
+        store accounts and manages lifetime)."""
+        with self._lock:
+            if object_id in self._segments:
+                return
+            self._ensure_capacity(size)
+            seg = shared_memory.SharedMemory(
+                name=_segment_name(self._session, object_id),
+                create=False, **_TRACK_KW)
+            self._segments[object_id] = seg
+            self._sizes[object_id] = size
+            self._used += size
+            self._sealed[object_id] = time.monotonic()
+
+    # -- read path ---------------------------------------------------------
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._sealed or object_id in self._spilled
+
+    def segment_for(self, object_id: ObjectID) -> Optional[Tuple[str, int]]:
+        """(segment_name, size) for a sealed object, restoring a spilled
+        copy first if needed. None if unknown."""
+        with self._lock:
+            if object_id in self._sealed:
+                self._sealed.move_to_end(object_id)
+                return (_segment_name(self._session, object_id),
+                        self._sizes[object_id])
+        if object_id in self._spilled:
+            self._restore(object_id)
+            return self.segment_for(object_id)
+        return None
+
+    def get_local(self, object_id: ObjectID) -> Optional[memoryview]:
+        """Zero-copy view for in-process readers."""
+        info = self.segment_for(object_id)
+        if info is None:
+            return None
+        with self._lock:
+            seg = self._segments[object_id]
+            return seg.buf[:self._sizes[object_id]]
+
+    # -- lifetime ----------------------------------------------------------
+
+    def free(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._free_locked(object_id)
+
+    def _free_locked(self, object_id: ObjectID) -> None:
+        seg = self._segments.pop(object_id, None)
+        if seg is not None:
+            size = self._sizes.pop(object_id)
+            self._sealed.pop(object_id, None)
+            self._used -= size
+            try:
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+            self._close_or_defer(seg)
+            self._drain_zombies()
+        spilled = self._spilled.pop(object_id, None)
+        if spilled is not None:
+            try:
+                os.unlink(spilled[0])
+            except FileNotFoundError:
+                pass
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for oid in list(self._segments):
+                self._free_locked(oid)
+            for oid in list(self._spilled):
+                self._free_locked(oid)
+            self._drain_zombies()
+
+    # -- spilling ----------------------------------------------------------
+
+    def _ensure_capacity(self, incoming: int) -> None:
+        # Called with lock held.
+        if incoming > self._capacity:
+            raise ObjectStoreFullError(
+                f"object of {incoming} bytes exceeds store capacity "
+                f"{self._capacity}")
+        limit = self._capacity * self._spill_threshold
+        while self._used + incoming > limit and self._sealed:
+            victim, _ = next(iter(self._sealed.items()))
+            self._spill_locked(victim)
+        if self._used + incoming > self._capacity:
+            raise ObjectStoreFullError(
+                f"store full: used={self._used} incoming={incoming}")
+
+    def _spill_path(self, object_id: ObjectID) -> str:
+        d = self._spill_dir or os.path.join("/tmp", f"rtpu_{self._session}",
+                                            "spill")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, object_id.hex())
+
+    def _spill_locked(self, object_id: ObjectID) -> None:
+        seg = self._segments.pop(object_id)
+        size = self._sizes.pop(object_id)
+        self._sealed.pop(object_id)
+        path = self._spill_path(object_id)
+        with open(path, "wb") as f:
+            f.write(seg.buf[:size])
+        seg.unlink()
+        self._close_or_defer(seg)
+        self._used -= size
+        self._spilled[object_id] = (path, size)
+        self.num_spilled += 1
+
+    def _restore(self, object_id: ObjectID) -> None:
+        with self._lock:
+            entry = self._spilled.pop(object_id, None)
+            if entry is None:
+                return
+            path, size = entry
+            self._ensure_capacity(size)
+            seg = shared_memory.SharedMemory(
+                name=_segment_name(self._session, object_id),
+                create=True, size=max(size, 1), **_TRACK_KW)
+            with open(path, "rb") as f:
+                f.readinto(seg.buf[:size])
+            os.unlink(path)
+            self._segments[object_id] = seg
+            self._sizes[object_id] = size
+            self._used += size
+            self._sealed[object_id] = time.monotonic()
+            self.num_restored += 1
+
+    # -- stats -------------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "used_bytes": self._used,
+                "capacity_bytes": self._capacity,
+                "num_objects": len(self._sealed),
+                "num_spilled": self.num_spilled,
+                "num_restored": self.num_restored,
+            }
+
+
+class ShmClient:
+    """Reader-side attach/read for any process on the node."""
+
+    def __init__(self, session: str):
+        self._session = session
+        self._attached: Dict[str, shared_memory.SharedMemory] = {}
+        self._lock = threading.Lock()
+
+    def read(self, segment_name: str, size: int) -> memoryview:
+        with self._lock:
+            seg = self._attached.get(segment_name)
+            if seg is None:
+                seg = shared_memory.SharedMemory(name=segment_name,
+                                                 create=False, **_TRACK_KW)
+                self._attached[segment_name] = seg
+            return seg.buf[:size]
+
+    def release(self, segment_name: str) -> None:
+        with self._lock:
+            seg = self._attached.pop(segment_name, None)
+            if seg is not None:
+                seg.close()
+
+    def close(self) -> None:
+        with self._lock:
+            for seg in self._attached.values():
+                try:
+                    seg.close()
+                except (BufferError, Exception):
+                    pass
+            self._attached.clear()
+
+
+class MemoryStore:
+    """Per-process store for small objects and pending results.
+
+    Doubles as the synchronization point for ``get``: waiters block on a
+    condition until the object (or an error) lands.
+    """
+
+    def __init__(self):
+        self._store: Dict[ObjectID, object] = {}
+        self._cv = threading.Condition()
+
+    def put(self, object_id: ObjectID, value: object) -> None:
+        with self._cv:
+            self._store[object_id] = value
+            self._cv.notify_all()
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._cv:
+            return object_id in self._store
+
+    def get(self, object_id: ObjectID,
+            timeout: Optional[float] = None) -> object:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while object_id not in self._store:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(f"timed out waiting for {object_id}")
+                self._cv.wait(remaining)
+            return self._store[object_id]
+
+    def wait(self, object_ids: List[ObjectID], num_returns: int,
+             timeout: Optional[float]) -> Tuple[Set[ObjectID], Set[ObjectID]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                ready = {o for o in object_ids if o in self._store}
+                if len(ready) >= num_returns:
+                    break
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                self._cv.wait(remaining)
+            not_ready = {o for o in object_ids if o not in ready}
+            return ready, not_ready
+
+    def free(self, object_id: ObjectID) -> None:
+        with self._cv:
+            self._store.pop(object_id, None)
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._store)

@@ -1,0 +1,312 @@
+"""ISchedulingPolicy — the plugin seam the TPU kernel slots into.
+
+Reference: ``src/ray/raylet/scheduling/policy/scheduling_policy.h``
+(``ISchedulingPolicy``), ``hybrid_scheduling_policy.cc``,
+``spread_scheduling_policy.cc``, ``random_scheduling_policy.cc``,
+``node_affinity_scheduling_policy.cc``, ``composite_scheduling_policy.cc``
+[UNVERIFIED — mount empty, SURVEY.md §0].
+
+The seam is deliberately batch-first: ``schedule_batch`` takes a list of
+requests so a backend can amortize one device launch over many pending
+tasks (the per-request ``schedule`` is sugar over a batch of one). The
+CPU policies below are the portable baseline; the TPU-backed policy in
+``ray_tpu._private.scheduler.tpu_policy`` registers itself under the
+same interface (BASELINE.json:5 north star).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.scheduler.resources import (
+    ClusterResourceManager,
+    NodeResources,
+    ResourceRequest,
+)
+
+
+@dataclass
+class SchedulingRequest:
+    demand: ResourceRequest
+    preferred_node: Optional[NodeID] = None   # usually the submitting node
+    avoid_local: bool = False
+    strategy: object = None                   # public SchedulingStrategy or None
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulingResult:
+    node_id: Optional[NodeID]   # None => infeasible or unavailable now
+    is_infeasible: bool = False # no node could EVER satisfy the demand
+
+
+class ISchedulingPolicy:
+    """Pick a node for each request against the cluster resource view."""
+
+    name = "base"
+
+    def schedule_batch(self, cluster: ClusterResourceManager,
+                       requests: Sequence[SchedulingRequest]
+                       ) -> List[SchedulingResult]:
+        raise NotImplementedError
+
+    def schedule(self, cluster: ClusterResourceManager,
+                 request: SchedulingRequest) -> SchedulingResult:
+        return self.schedule_batch(cluster, [request])[0]
+
+
+class HybridSchedulingPolicy(ISchedulingPolicy):
+    """Default policy: pack locally until the preferred node's critical
+    resource utilization crosses ``scheduler_spread_threshold``, then
+    pick the least-utilized feasible+available node (top-k randomized
+    tie-break). Pure-Python baseline of the reference's C++ policy; the
+    benchmark baseline proper is the C++ build in ``native/``.
+    """
+
+    name = "hybrid"
+
+    def __init__(self, spread_threshold: Optional[float] = None,
+                 seed: Optional[int] = None):
+        cfg = get_config()
+        self._threshold = (spread_threshold if spread_threshold is not None
+                           else cfg.scheduler_spread_threshold)
+        self._rng = random.Random(seed)
+
+    def schedule_batch(self, cluster, requests):
+        results: List[SchedulingResult] = []
+        # The batch is scheduled sequentially against a mutable copy of
+        # the availability view so requests in one batch don't all pile
+        # onto the same node.
+        view = cluster.snapshot()
+        for req in requests:
+            results.append(self._schedule_one(view, req))
+        return results
+
+    def _schedule_one(self, view: Dict[NodeID, NodeResources],
+                      req: SchedulingRequest) -> SchedulingResult:
+        # 1. prefer the local node while it is under-utilized
+        pref = req.preferred_node
+        if pref is not None and not req.avoid_local:
+            node = view.get(pref)
+            if (node is not None and node.alive
+                    and node.critical_utilization() < self._threshold
+                    and node.is_available(req.demand)):
+                node.allocate(req.demand)
+                return SchedulingResult(pref)
+        # 2. least-utilized among available nodes
+        best: List[tuple] = []
+        any_feasible = False
+        for nid, node in view.items():
+            if not node.alive or not node.is_feasible(req.demand):
+                continue
+            any_feasible = True
+            if not node.is_available(req.demand):
+                continue
+            best.append((node.critical_utilization(), nid))
+        if not best:
+            return SchedulingResult(None, is_infeasible=not any_feasible)
+        best.sort(key=lambda t: t[0])
+        cfg = get_config()
+        k = max(cfg.scheduler_top_k_absolute,
+                int(len(best) * cfg.scheduler_top_k_fraction))
+        _, chosen = self._rng.choice(best[:k])
+        view[chosen].allocate(req.demand)
+        return SchedulingResult(chosen)
+
+
+class SpreadSchedulingPolicy(ISchedulingPolicy):
+    """Round-robin over available nodes (reference: spread policy)."""
+
+    name = "spread"
+
+    def __init__(self):
+        self._next = 0
+
+    def schedule_batch(self, cluster, requests):
+        view = cluster.snapshot()
+        order = sorted(view.keys())
+        results = []
+        for req in requests:
+            chosen = None
+            any_feasible = False
+            for i in range(len(order)):
+                nid = order[(self._next + i) % len(order)] if order else None
+                if nid is None:
+                    break
+                node = view[nid]
+                if not node.alive or not node.is_feasible(req.demand):
+                    continue
+                any_feasible = True
+                if node.is_available(req.demand):
+                    chosen = nid
+                    self._next = (self._next + i + 1) % len(order)
+                    break
+            if chosen is None:
+                results.append(SchedulingResult(None,
+                                                is_infeasible=not any_feasible))
+            else:
+                view[chosen].allocate(req.demand)
+                results.append(SchedulingResult(chosen))
+        return results
+
+
+class RandomSchedulingPolicy(ISchedulingPolicy):
+    name = "random"
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+
+    def schedule_batch(self, cluster, requests):
+        view = cluster.snapshot()
+        results = []
+        for req in requests:
+            avail = [nid for nid, n in view.items()
+                     if n.alive and n.is_available(req.demand)]
+            feasible = any(n.alive and n.is_feasible(req.demand)
+                           for n in view.values())
+            if not avail:
+                results.append(SchedulingResult(None, is_infeasible=not feasible))
+            else:
+                chosen = self._rng.choice(avail)
+                view[chosen].allocate(req.demand)
+                results.append(SchedulingResult(chosen))
+        return results
+
+
+class NodeAffinitySchedulingPolicy(ISchedulingPolicy):
+    """Pin to a specific node; ``soft`` falls back to hybrid."""
+
+    name = "node_affinity"
+
+    def __init__(self, node_id: NodeID, soft: bool = False):
+        self._node_id = node_id
+        self._soft = soft
+        self._fallback = HybridSchedulingPolicy()
+
+    def schedule_batch(self, cluster, requests):
+        results = []
+        for req in requests:
+            node = cluster.get_node(self._node_id)
+            if node is not None and node.alive and node.is_available(req.demand):
+                results.append(SchedulingResult(self._node_id))
+            elif self._soft:
+                results.append(self._fallback.schedule(cluster, req))
+            else:
+                feasible = node is not None and node.alive and \
+                    node.is_feasible(req.demand)
+                results.append(SchedulingResult(None, is_infeasible=not feasible))
+        return results
+
+
+class NodeLabelSchedulingPolicy(ISchedulingPolicy):
+    """Filter nodes by label equality constraints, then hybrid-score."""
+
+    name = "node_label"
+
+    def __init__(self, hard: Dict[str, str],
+                 soft: Optional[Dict[str, str]] = None):
+        self._hard = hard
+        self._soft = soft or {}
+        self._inner = HybridSchedulingPolicy()
+
+    def schedule_batch(self, cluster, requests):
+        results = []
+        for req in requests:
+            view = cluster.snapshot()
+            matching = {nid: n for nid, n in view.items()
+                        if all(n.labels.get(k) == v
+                               for k, v in self._hard.items())}
+            soft_matching = {nid: n for nid, n in matching.items()
+                            if all(n.labels.get(k) == v
+                                   for k, v in self._soft.items())}
+            pool = soft_matching or matching
+            sub = ClusterResourceManager()
+            for nid, n in pool.items():
+                sub.add_or_update_node(nid, n)
+            results.append(self._inner.schedule(sub, req))
+        return results
+
+
+# --- registry ------------------------------------------------------------
+
+_POLICY_REGISTRY: Dict[str, Callable[[], ISchedulingPolicy]] = {}
+
+
+def register_policy(name: str, factory: Callable[[], ISchedulingPolicy]):
+    _POLICY_REGISTRY[name] = factory
+
+
+def create_policy(name: str) -> ISchedulingPolicy:
+    if name not in _POLICY_REGISTRY:
+        raise ValueError(f"unknown scheduling policy {name!r}; "
+                         f"known: {sorted(_POLICY_REGISTRY)}")
+    return _POLICY_REGISTRY[name]()
+
+
+register_policy("hybrid", HybridSchedulingPolicy)
+register_policy("spread", SpreadSchedulingPolicy)
+register_policy("random", RandomSchedulingPolicy)
+
+
+class CompositeSchedulingPolicy(ISchedulingPolicy):
+    """Dispatch per-request by its SchedulingStrategy (reference:
+    ``policy/composite_scheduling_policy.cc``): default requests go to
+    the inner policy (hybrid or TPU), NodeAffinity / NodeLabel / PG
+    strategies route to their dedicated policies.
+    """
+
+    name = "composite"
+
+    def __init__(self, inner: Optional[ISchedulingPolicy] = None):
+        self._inner = inner or HybridSchedulingPolicy()
+        self._spread = SpreadSchedulingPolicy()
+
+    def schedule_batch(self, cluster, requests):
+        from ray_tpu._private.ids import NodeID
+
+        results: List[Optional[SchedulingResult]] = [None] * len(requests)
+        default_batch: List[tuple] = []  # (index, request)
+        for i, req in enumerate(requests):
+            strat = req.strategy
+            kind = getattr(strat, "kind", None)
+            if kind == "NODE_AFFINITY":
+                pol = NodeAffinitySchedulingPolicy(
+                    NodeID.from_hex(strat.node_id), soft=strat.soft)
+                results[i] = pol.schedule(cluster, req)
+            elif kind == "NODE_LABEL":
+                pol = NodeLabelSchedulingPolicy(strat.hard, strat.soft)
+                results[i] = pol.schedule(cluster, req)
+            elif kind == "SPREAD":
+                results[i] = self._spread.schedule(cluster, req)
+            else:
+                # DEFAULT and PLACEMENT_GROUP (PG requests are rewritten
+                # to bundle node affinity before reaching the policy).
+                default_batch.append((i, req))
+        if default_batch:
+            inner_results = self._inner.schedule_batch(
+                cluster, [r for _, r in default_batch])
+            for (i, _), res in zip(default_batch, inner_results):
+                results[i] = res
+        return results
+
+
+def default_policy() -> ISchedulingPolicy:
+    cfg = get_config()
+    inner: ISchedulingPolicy
+    if cfg.use_tpu_scheduler:
+        try:
+            from ray_tpu._private.scheduler import tpu_policy  # noqa: F401
+            inner = create_policy("tpu")
+        except (ImportError, ValueError) as e:
+            import logging
+            logging.getLogger(__name__).warning(
+                "use_tpu_scheduler=1 but the TPU policy is unavailable "
+                "(%s); falling back to hybrid", e)
+            inner = create_policy("hybrid")
+    else:
+        inner = create_policy("hybrid")
+    return CompositeSchedulingPolicy(inner)

@@ -1,0 +1,128 @@
+"""Resource bookkeeping for cluster scheduling.
+
+Reference: ``src/ray/raylet/scheduling/cluster_resource_manager`` +
+``local_resource_manager`` [UNVERIFIED — mount empty, SURVEY.md §0].
+The cluster view is eventually consistent (updated by node reports);
+the local view is authoritative for the node's own dispatch.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, Optional, Tuple
+
+from ray_tpu._private.ids import NodeID
+
+ResourceRequest = Dict[str, float]
+
+_EPS = 1e-9
+
+
+@dataclass
+class NodeResources:
+    total: Dict[str, float] = field(default_factory=dict)
+    available: Dict[str, float] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+
+    @staticmethod
+    def of(**total: float) -> "NodeResources":
+        return NodeResources(total=dict(total), available=dict(total))
+
+    def is_feasible(self, demand: ResourceRequest) -> bool:
+        """Could this node EVER run the request (vs. total)."""
+        return all(self.total.get(k, 0.0) + _EPS >= v for k, v in demand.items())
+
+    def is_available(self, demand: ResourceRequest) -> bool:
+        return all(self.available.get(k, 0.0) + _EPS >= v
+                   for k, v in demand.items())
+
+    def allocate(self, demand: ResourceRequest) -> bool:
+        if not self.is_available(demand):
+            return False
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+        return True
+
+    def free(self, demand: ResourceRequest) -> None:
+        for k, v in demand.items():
+            self.available[k] = min(self.total.get(k, 0.0),
+                                    self.available.get(k, 0.0) + v)
+
+    def critical_utilization(self) -> float:
+        """max over resources of used/total — the hybrid policy's packing
+        signal (reference: HybridSchedulingPolicy)."""
+        worst = 0.0
+        for k, tot in self.total.items():
+            if tot <= 0:
+                continue
+            used = tot - self.available.get(k, 0.0)
+            worst = max(worst, used / tot)
+        return worst
+
+    def copy(self) -> "NodeResources":
+        return NodeResources(dict(self.total), dict(self.available),
+                             dict(self.labels), self.alive)
+
+
+class ClusterResourceManager:
+    """View of every node's resources, keyed by NodeID.
+
+    Thread-safe; the scheduler reads it, node reports / local dispatch
+    write it.
+    """
+
+    def __init__(self):
+        self._nodes: Dict[NodeID, NodeResources] = {}
+        self._lock = threading.RLock()
+        self._version = 0  # bumped on every mutation; lets the TPU policy
+        #                    invalidate its device-resident resource matrix.
+
+    def add_or_update_node(self, node_id: NodeID,
+                           resources: NodeResources) -> None:
+        with self._lock:
+            self._nodes[node_id] = resources
+            self._version += 1
+
+    def remove_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+            self._version += 1
+
+    def get_node(self, node_id: NodeID) -> Optional[NodeResources]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def nodes(self) -> Iterator[Tuple[NodeID, NodeResources]]:
+        with self._lock:
+            return iter(list(self._nodes.items()))
+
+    def num_nodes(self) -> int:
+        with self._lock:
+            return len(self._nodes)
+
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def allocate(self, node_id: NodeID, demand: ResourceRequest) -> bool:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                return False
+            ok = node.allocate(demand)
+            if ok:
+                self._version += 1
+            return ok
+
+    def free(self, node_id: NodeID, demand: ResourceRequest) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node.free(demand)
+                self._version += 1
+
+    def snapshot(self) -> Dict[NodeID, NodeResources]:
+        with self._lock:
+            return {nid: r.copy() for nid, r in self._nodes.items()}

@@ -1,0 +1,170 @@
+"""Serialization context: cloudpickle + out-of-band zero-copy buffers.
+
+Mirrors the reference's ``python/ray/_private/serialization.py`` +
+vendored cloudpickle [UNVERIFIED — mount empty, SURVEY.md §0]: pickle
+protocol 5 with a buffer callback so large numpy / jax host buffers are
+carried out-of-band and can be written into (and mmap-read from) the
+shared-memory store without a copy. ObjectRefs captured inside a value
+are recorded so the owner can bump reference counts (the borrowing
+protocol's serialization half).
+
+Wire format of a stored object:
+    header: msgpack {n_buffers, meta_len, buffer_lens, ref_bytes}
+    body:   pickled bytes | buffer 0 | buffer 1 ... (8-byte aligned)
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+import msgpack
+
+_ALIGN = 8
+
+
+class SerializedObject:
+    """A serialized value: metadata bytes + list of zero-copy buffers."""
+
+    __slots__ = ("meta", "buffers", "contained_refs")
+
+    def __init__(self, meta: bytes, buffers: List[memoryview], contained_refs):
+        self.meta = meta
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    def total_bytes(self) -> int:
+        total = len(self.meta)
+        for b in self.buffers:
+            total = _aligned(total) + b.nbytes
+        return total
+
+    def to_bytes(self) -> bytes:
+        """Flatten into one contiguous blob (header + meta + buffers)."""
+        header = _pack_header(self)
+        out = bytearray(header)
+        out += self.meta
+        for b in self.buffers:
+            pad = _aligned(len(out)) - len(out)
+            out += b"\x00" * pad
+            out += b
+        return bytes(out)
+
+    def write_into(self, dest: memoryview) -> int:
+        header = _pack_header(self)
+        off = 0
+        dest[off:off + len(header)] = header
+        off += len(header)
+        dest[off:off + len(self.meta)] = self.meta
+        off += len(self.meta)
+        for b in self.buffers:
+            aligned = _aligned(off)
+            if aligned != off:
+                dest[off:aligned] = b"\x00" * (aligned - off)
+                off = aligned
+            flat = b.cast("B") if b.ndim != 1 or b.format != "B" else b
+            dest[off:off + flat.nbytes] = flat
+            off += flat.nbytes
+        return off
+
+    def size_with_header(self) -> int:
+        header = _pack_header(self)
+        off = len(header) + len(self.meta)
+        for b in self.buffers:
+            off = _aligned(off) + b.nbytes
+        return off
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _pack_header(obj: SerializedObject) -> bytes:
+    payload = msgpack.packb(
+        {
+            "m": len(obj.meta),
+            "b": [b.nbytes for b in obj.buffers],
+            "r": [r.binary() for r in obj.contained_refs],
+        }
+    )
+    return len(payload).to_bytes(4, "little") + payload
+
+
+def _unpack_header(blob: memoryview) -> Tuple[dict, int]:
+    hlen = int.from_bytes(bytes(blob[:4]), "little")
+    header = msgpack.unpackb(bytes(blob[4:4 + hlen]))
+    return header, 4 + hlen
+
+
+class SerializationContext:
+    """Per-worker serializer with a custom-type registry."""
+
+    def __init__(self):
+        self._custom: Dict[type, Tuple[Callable, Callable]] = {}
+        self._lock = threading.Lock()
+        self._thread = threading.local()
+
+    def register_custom_serializer(self, cls: type, serializer: Callable,
+                                   deserializer: Callable):
+        with self._lock:
+            self._custom[cls] = (serializer, deserializer)
+
+    # -- serialize ---------------------------------------------------------
+
+    def serialize(self, value: Any) -> SerializedObject:
+        from ray_tpu._private.object_ref import ObjectRef
+
+        buffers: List[pickle.PickleBuffer] = []
+        contained_refs: List = []
+        self._thread.contained_refs = contained_refs
+
+        def buffer_cb(buf: pickle.PickleBuffer) -> bool:
+            buffers.append(buf)
+            return False  # out-of-band
+
+        try:
+            meta = cloudpickle.dumps(
+                value, protocol=5, buffer_callback=buffer_cb
+            )
+        finally:
+            self._thread.contained_refs = None
+        views = [b.raw() for b in buffers]
+        return SerializedObject(meta, views, contained_refs)
+
+    def note_contained_ref(self, ref) -> None:
+        refs = getattr(self._thread, "contained_refs", None)
+        if refs is not None:
+            refs.append(ref)
+
+    # -- deserialize -------------------------------------------------------
+
+    def deserialize_from_blob(self, blob: memoryview) -> Tuple[Any, List]:
+        """Deserialize; numpy arrays alias ``blob`` (zero-copy) so the
+        caller must keep the backing store pinned while the value lives."""
+        header, off = _unpack_header(blob)
+        meta_len = header["m"]
+        meta = bytes(blob[off:off + meta_len])
+        off += meta_len
+        bufs: List[memoryview] = []
+        for blen in header["b"]:
+            off = _aligned(off)
+            bufs.append(blob[off:off + blen])
+            off += blen
+        value = pickle.loads(meta, buffers=bufs)
+        refs = header.get("r", [])
+        return value, refs
+
+
+_context: Optional[SerializationContext] = None
+_context_lock = threading.Lock()
+
+
+def get_context() -> SerializationContext:
+    global _context
+    if _context is None:
+        with _context_lock:
+            if _context is None:
+                _context = SerializationContext()
+    return _context

@@ -1,0 +1,184 @@
+"""Caller-side task manager: pending tasks, retries, lineage.
+
+Reference: ``src/ray/core_worker/task_manager.{h,cc}`` [UNVERIFIED —
+mount empty, SURVEY.md §0]. Owns the lifecycle of every submitted task:
+records lineage (spec kept while its outputs may need reconstruction),
+decides retry vs. fail on completion, and materializes results into the
+owner's stores.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu.exceptions import TaskError, WorkerCrashedError
+
+
+@dataclass
+class TaskRecord:
+    spec: TaskSpec
+    retries_left: int
+    status: str = "pending"          # pending|running|finished|failed
+    attempt: int = 0
+    error: Optional[str] = None
+
+
+class Entry:
+    """A resolved object in the owner's directory (see MemoryStore)."""
+
+    __slots__ = ("kind", "data", "_value", "_has_value", "contained")
+
+    def __init__(self, kind: str, data, contained=()):
+        self.kind = kind          # "blob" | "shm" | "err"
+        self.data = data
+        self.contained = contained
+        self._value = None
+        self._has_value = False
+
+    def cached_value(self):
+        return (self._has_value, self._value)
+
+    def cache_value(self, value):
+        self._value = value
+        self._has_value = True
+
+
+class TaskManager:
+    def __init__(self,
+                 store_result: Callable[[ObjectID, Entry], None],
+                 resubmit: Callable[[TaskSpec], None],
+                 on_task_arg_release: Callable[[ObjectID], None]):
+        self._lock = threading.RLock()
+        self._tasks: Dict[TaskID, TaskRecord] = {}
+        self._lineage: Dict[ObjectID, TaskID] = {}
+        self._store_result = store_result
+        self._resubmit = resubmit
+        self._release_arg = on_task_arg_release
+        self.num_finished = 0
+        self.num_failed = 0
+        self.num_retries = 0
+
+    # -- submission --------------------------------------------------------
+
+    def add_pending_task(self, spec: TaskSpec) -> None:
+        with self._lock:
+            self._tasks[spec.task_id] = TaskRecord(
+                spec=spec, retries_left=spec.max_retries)
+            for oid in spec.return_ids:
+                self._lineage[oid] = spec.task_id
+
+    def mark_running(self, task_id: TaskID) -> None:
+        with self._lock:
+            rec = self._tasks.get(task_id)
+            if rec:
+                rec.status = "running"
+
+    def get_record(self, task_id: TaskID) -> Optional[TaskRecord]:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    # -- completion --------------------------------------------------------
+
+    def complete_task(self, task_id: TaskID,
+                      results: List[tuple],
+                      error_blob: Optional[bytes],
+                      system_error: Optional[BaseException] = None) -> None:
+        """``results``: [(oid_bytes, kind, data, contained_ref_bytes)].
+        ``error_blob``: serialized TaskError (app-level).
+        ``system_error``: worker crash etc. — always retryable."""
+        with self._lock:
+            rec = self._tasks.get(task_id)
+            if rec is None:
+                return
+            if error_blob is None and system_error is None:
+                rec.status = "finished"
+                self.num_finished += 1
+                self._release_args(rec.spec)
+                for oid_b, kind, data, contained in results:
+                    entry = Entry(
+                        "blob" if kind == "inline" else "shm", data,
+                        tuple(ObjectID(c) for c in contained))
+                    self._store_result(ObjectID(oid_b), entry)
+                return
+            # failure path
+            retryable = system_error is not None
+            if error_blob is not None and rec.spec.retry_exceptions:
+                retryable = self._error_matches(
+                    error_blob, rec.spec.retry_exceptions)
+            if retryable and rec.retries_left > 0:
+                rec.retries_left -= 1
+                rec.attempt += 1
+                rec.status = "pending"
+                self.num_retries += 1
+                self._resubmit(rec.spec)
+                return
+            rec.status = "failed"
+            self.num_failed += 1
+            self._release_args(rec.spec)
+            if error_blob is None:
+                from ray_tpu.exceptions import RayTpuError
+                if isinstance(system_error, RayTpuError):
+                    err: BaseException = system_error
+                else:
+                    err = TaskError(
+                        system_error, rec.spec.repr_name(),
+                        f"{type(system_error).__name__}: {system_error}")
+                error_blob = serialization.get_context().serialize(err).to_bytes()
+            for oid in rec.spec.return_ids:
+                self._store_result(oid, Entry("err", error_blob))
+
+    @staticmethod
+    def _error_matches(error_blob: bytes, retry_exceptions) -> bool:
+        if retry_exceptions is True:
+            return True
+        try:
+            err, _ = serialization.get_context().deserialize_from_blob(
+                memoryview(error_blob))
+            cause = getattr(err, "cause", None)
+            return cause is not None and isinstance(cause,
+                                                    tuple(retry_exceptions))
+        except Exception:
+            return False
+
+    def _release_args(self, spec: TaskSpec) -> None:
+        for oid in spec.dependencies():
+            self._release_arg(oid)
+
+    # -- lineage -----------------------------------------------------------
+
+    def lineage_task_for(self, object_id: ObjectID) -> Optional[TaskSpec]:
+        with self._lock:
+            tid = self._lineage.get(object_id)
+            if tid is None:
+                return None
+            rec = self._tasks.get(tid)
+            return rec.spec if rec else None
+
+    def release_lineage(self, object_id: ObjectID) -> None:
+        with self._lock:
+            tid = self._lineage.pop(object_id, None)
+            if tid is None:
+                return
+            if not any(t == tid for t in self._lineage.values()):
+                rec = self._tasks.get(tid)
+                if rec and rec.status in ("finished", "failed"):
+                    self._tasks.pop(tid, None)
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._tasks.values()
+                       if r.status in ("pending", "running"))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "pending": self.num_pending(),
+                "finished": self.num_finished,
+                "failed": self.num_failed,
+                "retries": self.num_retries,
+            }

@@ -1,0 +1,634 @@
+"""CoreWorker + the global driver singleton.
+
+Reference analogs [UNVERIFIED — mount empty, SURVEY.md §0]:
+``python/ray/_private/worker.py`` (global worker, init/connect,
+get/put/wait) and ``src/ray/core_worker/core_worker.cc`` (SubmitTask,
+actor submission, Put/Get/Wait) plus
+``transport/actor_task_submitter.cc`` (ordered per-actor queues).
+"""
+
+from __future__ import annotations
+
+import atexit
+import hashlib
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import get_config
+from ray_tpu._private.gcs import ActorInfo, GcsLite, NodeInfo
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+)
+from ray_tpu._private.node_manager import NodeManagerGroup
+from ray_tpu._private.object_store import MemoryStore, ShmStore
+from ray_tpu._private.ref_counting import ReferenceCounter
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.scheduler.policy import default_policy
+from ray_tpu._private.scheduler.resources import NodeResources
+from ray_tpu._private.task_manager import Entry, TaskManager
+from ray_tpu._private.task_spec import (
+    FunctionDescriptor,
+    TaskArg,
+    TaskOptions,
+    TaskSpec,
+    TaskType,
+)
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    TaskError,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _detect_num_tpus() -> int:
+    """TPU chips owned by this host process (0 if jax unusable)."""
+    if os.environ.get("RAY_TPU_FAKE_TPUS"):
+        return int(os.environ["RAY_TPU_FAKE_TPUS"])
+    try:
+        import jax
+        return sum(1 for d in jax.devices() if d.platform != "cpu")
+    except Exception:
+        return 0
+
+
+class Worker:
+    """The driver-side core worker (single owner in the v0 slice)."""
+
+    def __init__(self, num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 object_store_memory: Optional[int] = None,
+                 max_process_workers: Optional[int] = None,
+                 _system_config: Optional[dict] = None):
+        cfg = get_config()
+        if _system_config:
+            cfg.apply_system_config(_system_config)
+        self.session = os.urandom(4).hex()
+        self.job_id = JobID.from_int(1)
+        self.driver_task_id = TaskID.for_driver(self.job_id)
+        self._put_index = 0
+        self._counter_lock = threading.Lock()
+
+        self.serde = serialization.get_context()
+        self.memory_store = MemoryStore()
+        self.shm_store = ShmStore(
+            self.session,
+            object_store_memory or cfg.object_store_memory_bytes,
+            spill_threshold=cfg.object_spilling_threshold)
+        self.reference_counter = ReferenceCounter(self._on_ref_zero)
+        self.gcs = GcsLite()
+
+        self._functions: Dict[bytes, bytes] = {}   # fid -> cloudpickle blob
+        self._functions_lock = threading.Lock()
+
+        if num_cpus is None:
+            num_cpus = float(os.cpu_count() or 1)
+        if num_tpus is None:
+            num_tpus = float(_detect_num_tpus())
+        total = {"CPU": float(num_cpus)}
+        if num_tpus:
+            total["TPU"] = float(num_tpus)
+        total["memory"] = float(object_store_memory
+                                or cfg.object_store_memory_bytes)
+        if resources:
+            total.update({k: float(v) for k, v in resources.items()})
+        node_res = NodeResources(total=dict(total), available=dict(total))
+
+        self.task_manager = TaskManager(
+            store_result=self._store_result,
+            resubmit=self._resubmit,
+            on_task_arg_release=self.reference_counter.remove_task_argument)
+
+        if max_process_workers is None:
+            max_process_workers = max(2, min(8, int(num_cpus)))
+        self.node_group = NodeManagerGroup(
+            session=self.session,
+            memory_store=self.memory_store,
+            shm_store=self.shm_store,
+            policy=default_policy(),
+            complete_task_cb=self._complete_task,
+            function_blob_provider=self._get_function_blob,
+            driver_node_resources=node_res,
+            max_process_workers=max_process_workers)
+        self.node_group.set_actor_death_callback(self._on_actor_death)
+        self.gcs.register_node(NodeInfo(
+            node_id=self.node_group.head_node_id,
+            resources_total=dict(total)))
+
+        # per-actor ordered submission queues; _actor_flush_locks
+        # serialize pop+send per actor so concurrent flushers (driver
+        # thread + IO thread) can't reorder a queue's head.
+        self._actor_lock = threading.RLock()
+        self._actor_queues: Dict[ActorID, deque] = {}
+        self._actor_seq: Dict[ActorID, int] = {}
+        self._actor_specs: Dict[ActorID, TaskSpec] = {}   # creation specs
+        self._actor_restarts: Dict[ActorID, int] = {}
+        self._actor_flush_locks: Dict[ActorID, threading.RLock] = {}
+
+        prestart = cfg.worker_pool_prestart
+        if prestart:
+            raylet = self.node_group._raylets[self.node_group.head_node_id]
+            raylet.worker_pool.prestart(prestart)
+
+        self._shutdown = False
+
+    # ------------------------------------------------------------------
+    # counters / ids
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.for_normal_task(self.job_id)
+
+    def next_put_id(self) -> ObjectID:
+        with self._counter_lock:
+            self._put_index += 1
+            return ObjectID.for_put(self.driver_task_id, self._put_index)
+
+    # ------------------------------------------------------------------
+    # function registry
+
+    def register_function(self, fn) -> FunctionDescriptor:
+        blob = cloudpickle.dumps(fn)
+        fid = hashlib.sha1(blob).digest()
+        with self._functions_lock:
+            self._functions.setdefault(fid, blob)
+        return FunctionDescriptor(
+            function_id=fid,
+            module=getattr(fn, "__module__", "") or "",
+            name=getattr(fn, "__qualname__", repr(fn)))
+
+    def _get_function_blob(self, fid: bytes) -> bytes:
+        with self._functions_lock:
+            return self._functions[fid]
+
+    # ------------------------------------------------------------------
+    # object plane
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = self.next_put_id()
+        self._put_value(oid, value)
+        self.reference_counter.add_owned_object(oid)
+        return ObjectRef(oid)
+
+    def _put_value(self, oid: ObjectID, value: Any) -> None:
+        cfg = get_config()
+        ser = self.serde.serialize(value)
+        contained = tuple(ser.contained_refs)
+        size = ser.size_with_header()
+        if size <= cfg.max_direct_call_object_size:
+            entry = Entry("blob", ser.to_bytes(), contained)
+        else:
+            buf = self.shm_store.create(oid, size)
+            ser.write_into(buf)
+            self.shm_store.seal(oid)
+            from ray_tpu._private.object_store import _segment_name
+            entry = Entry("shm", (_segment_name(self.session, oid), size),
+                          contained)
+        self._store_result(oid, entry)
+
+    def _store_result(self, oid: ObjectID, entry: Entry) -> None:
+        if entry.kind == "shm" and not self.shm_store.contains(oid):
+            # result written by a worker process: adopt the segment
+            try:
+                self.shm_store.adopt(oid, entry.data[1])
+            except FileNotFoundError:
+                logger.warning("shm segment for %s vanished", oid)
+        if entry.contained:
+            self.reference_counter.add_contained(
+                oid, [c if isinstance(c, ObjectID) else ObjectID(c)
+                      for c in entry.contained])
+        self.memory_store.put(oid, entry)
+        self.node_group.on_object_available(oid)
+        self._flush_actor_queues()
+
+    def _on_ref_zero(self, oid: ObjectID) -> None:
+        self.memory_store.free(oid)
+        self.shm_store.free(oid)
+        self.task_manager.release_lineage(oid)
+
+    def get(self, refs: Sequence[ObjectRef],
+            timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: List[Any] = []
+        for ref in refs:
+            remaining = None
+            if deadline is not None:
+                remaining = max(0.0, deadline - time.monotonic())
+            try:
+                entry: Entry = self.memory_store.get(ref.id(), remaining)
+            except TimeoutError:
+                raise GetTimeoutError(
+                    f"get() timed out waiting for {ref}") from None
+            out.append(self._entry_value(ref.id(), entry))
+        return out
+
+    def _entry_value(self, oid: ObjectID, entry: Entry) -> Any:
+        has, val = entry.cached_value()
+        if has:
+            if entry.kind == "err":
+                raise val.as_instanceof_cause() if isinstance(val, TaskError) \
+                    else val
+            return val
+        if entry.kind == "err":
+            err, _ = self.serde.deserialize_from_blob(memoryview(entry.data))
+            entry.cache_value(err)
+            raise err.as_instanceof_cause() if isinstance(err, TaskError) \
+                else err
+        if entry.kind == "blob":
+            value, _ = self.serde.deserialize_from_blob(memoryview(entry.data))
+        else:  # shm
+            blob = self.shm_store.get_local(oid)
+            if blob is None:
+                raise GetTimeoutError(f"object {oid} no longer in store")
+            value, _ = self.serde.deserialize_from_blob(blob)
+        entry.cache_value(value)
+        return value
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None
+             ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        ids = [r.id() for r in refs]
+        ready_ids, _ = self.memory_store.wait(ids, num_returns, timeout)
+        ready, not_ready = [], []
+        for r in refs:
+            (ready if r.id() in ready_ids and len(ready) < num_returns
+             else not_ready).append(r)
+        return ready, not_ready
+
+    # ------------------------------------------------------------------
+    # task submission
+
+    def build_args(self, args: tuple, kwargs: dict,
+                   spec_args: List[TaskArg]) -> List[str]:
+        cfg = get_config()
+        kwargs_keys = list(kwargs.keys())
+        for value in list(args) + [kwargs[k] for k in kwargs_keys]:
+            if isinstance(value, ObjectRef):
+                spec_args.append(TaskArg.by_ref(value.id()))
+                self.reference_counter.add_task_argument(value.id())
+                continue
+            ser = self.serde.serialize(value)
+            size = ser.size_with_header()
+            if size <= cfg.max_direct_call_object_size and \
+                    not ser.contained_refs:
+                spec_args.append(TaskArg.by_value(ser.to_bytes()))
+            else:
+                # big arg (or ref-carrying): promote to owned object
+                oid = self.next_put_id()
+                self._put_value(oid, value)
+                self.reference_counter.add_owned_object(oid)
+                self.reference_counter.add_task_argument(oid)
+                # hold a ref until task completes via task_args count;
+                # no local ObjectRef needed.
+                spec_args.append(TaskArg.by_ref(oid))
+        return kwargs_keys
+
+    def submit_task(self, fn_descriptor: FunctionDescriptor, args: tuple,
+                    kwargs: dict, options: TaskOptions) -> List[ObjectRef]:
+        cfg = get_config()
+        task_id = self.next_task_id()
+        spec_args: List[TaskArg] = []
+        kwargs_keys = self.build_args(args, kwargs, spec_args)
+        num_returns = options.num_returns
+        return_ids = [ObjectID.from_index(task_id, i + 1)
+                      for i in range(num_returns)]
+        max_retries = (options.max_retries if options.max_retries is not None
+                       else cfg.task_max_retries)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.NORMAL_TASK,
+            function=fn_descriptor,
+            args=spec_args,
+            kwargs_keys=kwargs_keys,
+            num_returns=num_returns,
+            resources=options.resource_demand(),
+            max_retries=max_retries,
+            retry_exceptions=options.retry_exceptions,
+            scheduling_strategy=options.scheduling_strategy,
+            name=options.name or fn_descriptor.repr_name(),
+            return_ids=return_ids,
+        )
+        for oid in return_ids:
+            self.reference_counter.add_owned_object(oid)
+        self.task_manager.add_pending_task(spec)
+        self.node_group.submit_task(spec)
+        return [ObjectRef(oid) for oid in return_ids]
+
+    def _resubmit(self, spec: TaskSpec) -> None:
+        if spec.task_type == TaskType.ACTOR_TASK:
+            with self._actor_lock:
+                queue = self._actor_queues.get(spec.actor_id)
+                if queue is None:
+                    self._fail_task(spec, ActorDiedError(
+                        "actor is dead; cannot retry task"))
+                    return
+                queue.appendleft(spec)
+            self._flush_actor_queues()
+        else:
+            self.node_group.submit_task(spec)
+
+    def _fail_task(self, spec: TaskSpec, err: BaseException) -> None:
+        from ray_tpu.exceptions import RayTpuError
+        blob = self.serde.serialize(
+            err if isinstance(err, RayTpuError)
+            else TaskError(err, spec.repr_name(), str(err))).to_bytes()
+        for oid in spec.return_ids:
+            self._store_result(oid, Entry("err", blob))
+
+    def _complete_task(self, task_id: TaskID, results, err_blob,
+                       system_error) -> None:
+        rec = self.task_manager.get_record(task_id)
+        spec = rec.spec if rec else None
+        if spec is not None:
+            from ray_tpu._private import events
+            ok = err_blob is None and system_error is None
+            events.record(task_id.hex(), spec.repr_name(),
+                          "FINISHED" if ok else "FAILED")
+        if (spec is not None
+                and spec.task_type == TaskType.ACTOR_CREATION_TASK):
+            self._on_actor_creation_done(spec, err_blob, system_error)
+        self.task_manager.complete_task(task_id, results, err_blob,
+                                        system_error)
+
+    # ------------------------------------------------------------------
+    # actors
+
+    def create_actor(self, fn_descriptor: FunctionDescriptor, args: tuple,
+                     kwargs: dict, options: TaskOptions,
+                     class_name: str) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        task_id = self.next_task_id()
+        spec_args: List[TaskArg] = []
+        kwargs_keys = self.build_args(args, kwargs, spec_args)
+        demand = options.resource_demand(default_cpus=1.0)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            function=fn_descriptor,
+            args=spec_args,
+            kwargs_keys=kwargs_keys,
+            num_returns=0,
+            resources=demand,
+            max_retries=0,
+            actor_creation_id=actor_id,
+            max_restarts=options.max_restarts,
+            max_task_retries=options.max_task_retries,
+            name=options.name or class_name,
+            return_ids=[],
+        )
+        info = ActorInfo(
+            actor_id=actor_id, name=options.name,
+            namespace=options.namespace or "default",
+            max_restarts=options.max_restarts,
+            creation_spec=spec, class_name=class_name)
+        self.gcs.register_actor(info)
+        with self._actor_lock:
+            self._actor_queues[actor_id] = deque()
+            self._actor_seq[actor_id] = 0
+            self._actor_specs[actor_id] = spec
+            self._actor_restarts[actor_id] = options.max_restarts
+        self.task_manager.add_pending_task(spec)
+        self.node_group.submit_task(spec)
+        return actor_id
+
+    def _on_actor_creation_done(self, spec: TaskSpec, err_blob,
+                                system_error) -> None:
+        actor_id = spec.actor_creation_id
+        if err_blob is None and system_error is None:
+            self.gcs.update_actor_state(actor_id, "ALIVE")
+            self._flush_actor_queues()
+        else:
+            self.gcs.update_actor_state(actor_id, "DEAD",
+                                        death_cause="creation failed")
+            self._fail_actor_queue(actor_id, err_blob)
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args: tuple, kwargs: dict,
+                          options: TaskOptions) -> List[ObjectRef]:
+        info = self.gcs.get_actor_info(actor_id)
+        if info is None:
+            raise ValueError(f"unknown actor {actor_id}")
+        task_id = TaskID.of(actor_id)
+        spec_args: List[TaskArg] = []
+        kwargs_keys = self.build_args(args, kwargs, spec_args)
+        num_returns = options.num_returns
+        return_ids = [ObjectID.from_index(task_id, i + 1)
+                      for i in range(num_returns)]
+        with self._actor_lock:
+            seq = self._actor_seq[actor_id] = self._actor_seq.get(actor_id,
+                                                                  0) + 1
+        creation = self._actor_specs.get(actor_id)
+        spec = TaskSpec(
+            task_id=task_id,
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_TASK,
+            function=creation.function if creation else
+            FunctionDescriptor(b"", "", method_name),
+            args=spec_args,
+            kwargs_keys=kwargs_keys,
+            num_returns=num_returns,
+            resources={},
+            max_retries=(creation.max_task_retries if creation else 0),
+            actor_id=actor_id,
+            sequence_number=seq,
+            name=f"{info.class_name}.{method_name}",
+            return_ids=return_ids,
+        )
+        spec.method_name = method_name  # type: ignore[attr-defined]
+        for oid in return_ids:
+            self.reference_counter.add_owned_object(oid)
+        self.task_manager.add_pending_task(spec)
+        if info.state == "DEAD":
+            self._fail_task(spec, ActorDiedError(
+                f"actor {info.class_name} is dead: {info.death_cause}"))
+        else:
+            with self._actor_lock:
+                self._actor_queues[actor_id].append(spec)
+            self._flush_actor_queues()
+        return [ObjectRef(oid) for oid in return_ids]
+
+    def _flush_actor_queues(self) -> None:
+        with self._actor_lock:
+            actor_ids = [aid for aid, q in self._actor_queues.items() if q]
+        for actor_id in actor_ids:
+            self._flush_one_actor(actor_id)
+
+    def _flush_one_actor(self, actor_id: ActorID) -> None:
+        info = self.gcs.get_actor_info(actor_id)
+        if info is None or info.state != "ALIVE":
+            return
+        with self._actor_lock:
+            flush_lock = self._actor_flush_locks.setdefault(
+                actor_id, threading.RLock())
+        # Serialize the whole pop+send per actor: without this, two
+        # flushers could pop seq N and N+1 and send them out of order.
+        with flush_lock:
+            while True:
+                with self._actor_lock:
+                    queue = self._actor_queues.get(actor_id)
+                    if not queue:
+                        return
+                    spec = queue[0]
+                    deps = spec.dependencies()
+                    if not all(self.memory_store.contains(d) for d in deps):
+                        return
+                    queue.popleft()
+                payload, dep_err = self._build_actor_payload(spec)
+                if dep_err is not None:
+                    self.task_manager.complete_task(spec.task_id, [],
+                                                    dep_err, None)
+                    continue
+                self.task_manager.mark_running(spec.task_id)
+                ok = self.node_group.submit_actor_task(actor_id, spec,
+                                                       payload)
+                if not ok:
+                    with self._actor_lock:
+                        self._actor_queues[actor_id].appendleft(spec)
+                    return
+
+    def _build_actor_payload(self, spec: TaskSpec):
+        arg_descs = []
+        for arg in spec.args:
+            if arg.object_id is None:
+                arg_descs.append(("v", arg.inline_blob))
+                continue
+            entry: Entry = self.memory_store.get(arg.object_id, timeout=0)
+            if entry.kind == "err":
+                return None, entry.data
+            if entry.kind == "blob":
+                arg_descs.append(("v", entry.data))
+            else:
+                name, size = entry.data
+                arg_descs.append(
+                    ("shm", arg.object_id.binary(), name, size))
+        payload = {
+            "type": "exec_actor",
+            "task_id": spec.task_id.binary(),
+            "actor_id": spec.actor_id.binary(),
+            "method": getattr(spec, "method_name", ""),
+            "function_id": spec.function.function_id,
+            "args": arg_descs,
+            "kwargs_keys": spec.kwargs_keys,
+            "num_returns": spec.num_returns,
+            "return_ids": [o.binary() for o in spec.return_ids],
+            "name": spec.repr_name(),
+        }
+        return payload, None
+
+    def _on_actor_death(self, actor_id: ActorID) -> None:
+        with self._actor_lock:
+            restarts_left = self._actor_restarts.get(actor_id, 0)
+            creation = self._actor_specs.get(actor_id)
+        info = self.gcs.get_actor_info(actor_id)
+        if restarts_left != 0 and creation is not None:
+            if restarts_left > 0:
+                with self._actor_lock:
+                    self._actor_restarts[actor_id] = restarts_left - 1
+            self.gcs.update_actor_state(actor_id, "RESTARTING")
+            if info:
+                info.num_restarts += 1
+            self.task_manager.add_pending_task(creation)
+            self.node_group.submit_task(creation)
+        else:
+            self.gcs.update_actor_state(actor_id, "DEAD",
+                                        death_cause="worker died")
+            self._fail_actor_queue(actor_id, None)
+
+    def _fail_actor_queue(self, actor_id: ActorID,
+                          err_blob: Optional[bytes]) -> None:
+        with self._actor_lock:
+            queue = self._actor_queues.get(actor_id)
+            specs = list(queue) if queue else []
+            if queue:
+                queue.clear()
+        for spec in specs:
+            if err_blob is not None:
+                self.task_manager.complete_task(spec.task_id, [], err_blob,
+                                                None)
+            else:
+                self._fail_task(spec, ActorDiedError("actor died"))
+
+    def kill_actor(self, actor_id: ActorID) -> None:
+        with self._actor_lock:
+            self._actor_restarts[actor_id] = 0
+        self.node_group.release_actor(actor_id, kill_worker=True)
+        self.gcs.update_actor_state(actor_id, "DEAD", death_cause="killed")
+        self._fail_actor_queue(actor_id, None)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        self.reference_counter.freeze()
+        self.node_group.shutdown()
+        self.shm_store.shutdown()
+
+    def cluster_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for _nid, res in self.node_group.cluster_resources.nodes():
+            for k, v in res.total.items():
+                total[k] = total.get(k, 0.0) + v
+        return total
+
+    def available_resources(self) -> Dict[str, float]:
+        total: Dict[str, float] = {}
+        for _nid, res in self.node_group.cluster_resources.nodes():
+            for k, v in res.available.items():
+                total[k] = total.get(k, 0.0) + v
+        return total
+
+
+# ---------------------------------------------------------------------------
+# global singleton
+
+_global_worker: Optional[Worker] = None
+_global_lock = threading.Lock()
+
+
+def init(**kwargs) -> Worker:
+    global _global_worker
+    with _global_lock:
+        if _global_worker is not None:
+            return _global_worker
+        _global_worker = Worker(**kwargs)
+        atexit.register(shutdown)
+        return _global_worker
+
+
+def shutdown() -> None:
+    global _global_worker
+    with _global_lock:
+        if _global_worker is not None:
+            _global_worker.shutdown()
+            _global_worker = None
+
+
+def global_worker() -> Worker:
+    if _global_worker is None:
+        init()
+    return _global_worker
+
+
+def try_global_worker() -> Optional[Worker]:
+    return _global_worker
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None

@@ -1,0 +1,302 @@
+"""Worker pool: process workers for CPU tasks, in-process threads for
+TPU tasks.
+
+Reference analog: ``src/ray/raylet/worker_pool.{h,cc}`` [UNVERIFIED —
+mount empty, SURVEY.md §0] — process leasing, prestart, dedicated
+workers for actors.
+
+TPU-first split (see worker_process.py docstring): exactly one process
+per host owns the TPU runtime, so anything demanding ``TPU`` resources
+executes on an in-process thread worker; pure-host tasks lease
+``exec``'d subprocesses that register back over the node's hub socket
+(the raylet pattern — no multiprocessing inheritance, no __main__
+re-import, no TPU state leaking into children).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.connection_hub import ConnectionHub
+from ray_tpu._private.ids import WorkerID
+from ray_tpu._private.worker_process import ExecutionEnv
+
+
+class BaseWorker:
+    def __init__(self):
+        self.worker_id = WorkerID.from_random()
+        self.known_functions: set = set()
+        self.leased = False
+        self.is_actor_worker = False
+        self.alive = True
+        self.ready = False
+        self.last_idle = time.monotonic()
+
+    def send(self, msg: tuple) -> None:
+        raise NotImplementedError
+
+    def kill(self) -> None:
+        raise NotImplementedError
+
+
+class ProcessWorker(BaseWorker):
+    """An exec'd subprocess; replies arrive on ``conn`` (set once the
+    child registers at the hub) and are routed by the node IO thread."""
+
+    kind = "process"
+
+    def __init__(self, session: str, max_inline_bytes: int,
+                 hub: ConnectionHub,
+                 on_ready: Callable[["ProcessWorker"], None]):
+        super().__init__()
+        self.conn = None
+        self._on_ready = on_ready
+        token = self.worker_id.hex()
+        hub.expect(token, self._register)
+        env = dict(os.environ)
+        # Children never own the TPU; any jax they import runs on CPU.
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+        env["PYTHONPATH"] = os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))]
+            + env.get("PYTHONPATH", "").split(os.pathsep))
+        entry = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "worker_entry.py")
+        self.proc = subprocess.Popen(
+            [sys.executable, entry,
+             "--address", hub.address, "--token", token,
+             "--session", session, "--max-inline", str(max_inline_bytes)],
+            env=env, start_new_session=True)
+        self.start_time = time.monotonic()
+
+    def _register(self, conn, pid: int) -> None:
+        self.conn = conn
+        self.ready = True
+        self._on_ready(self)
+
+    def send(self, msg: tuple) -> None:
+        if self.conn is None:
+            raise RuntimeError("worker not registered yet")
+        self.conn.send(msg)
+
+    def kill(self) -> None:
+        self.alive = False
+        try:
+            self.proc.terminate()
+        except Exception:
+            pass
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            except Exception:
+                pass
+
+
+class InProcessWorker(BaseWorker):
+    """A thread in the host process (TPU-capable). Executes the same
+    payloads as a process worker; replies go to ``reply_handler``."""
+
+    kind = "in_process"
+
+    def __init__(self, session: str, max_inline_bytes: int,
+                 reply_handler: Callable[["InProcessWorker", tuple], None]):
+        super().__init__()
+        self.env = ExecutionEnv(session, max_inline_bytes)
+        self._queue: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._reply = reply_handler
+        self.ready = True
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"rtpu-inproc-{self.worker_id.hex()[:6]}")
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            msg = self._queue.get()
+            if msg is None:
+                return
+            op = msg[0]
+            if op == "func":
+                self.env.cache_function(msg[1], msg[2])
+            elif op in ("exec", "create_actor", "exec_actor"):
+                reply = self.env.execute(msg[1])
+                self._reply(self, reply)
+
+    def send(self, msg: tuple) -> None:
+        if msg[0] == "shutdown":
+            self._queue.put(None)
+            return
+        self._queue.put(msg)
+
+    def kill(self) -> None:
+        # Threads can't be force-killed; mark dead and drain.
+        self.alive = False
+        self._queue.put(None)
+
+
+class WorkerPool:
+    """Leases workers per resource demand; dedicated leases for actors."""
+
+    def __init__(self, session: str, hub: ConnectionHub,
+                 reply_handler: Callable[[BaseWorker, tuple], None],
+                 on_worker_ready: Callable[[], None],
+                 max_process_workers: int = 8,
+                 max_inproc_workers: int = 16):
+        cfg = get_config()
+        self._session = session
+        self._hub = hub
+        self._max_inline = cfg.max_direct_call_object_size
+        self._reply_handler = reply_handler
+        self._on_worker_ready = on_worker_ready
+        self._max_process = max_process_workers
+        self._max_inproc = max_inproc_workers
+        self._idle_process: List[ProcessWorker] = []
+        self._idle_inproc: List[InProcessWorker] = []
+        self._all: Dict[WorkerID, BaseWorker] = {}
+        self._lock = threading.RLock()
+
+    # -- substrate choice --------------------------------------------------
+
+    @staticmethod
+    def substrate_for(resources: Dict[str, float]) -> str:
+        return "in_process" if resources.get("TPU", 0) > 0 else "process"
+
+    # -- leasing -----------------------------------------------------------
+
+    def pop_worker(self, resources: Dict[str, float],
+                   dedicated: bool = False) -> Optional[BaseWorker]:
+        """Returns a leased worker, or None (caller re-queues; a newly
+        spawned worker will wake the dispatcher when it registers)."""
+        substrate = self.substrate_for(resources)
+        with self._lock:
+            self._reap_dead()
+            idle = (self._idle_inproc if substrate == "in_process"
+                    else self._idle_process)
+            while idle:
+                w = idle.pop()
+                if w.alive:
+                    w.leased = True
+                    w.is_actor_worker = dedicated
+                    return w
+            count = sum(1 for w in self._all.values()
+                        if w.alive and w.kind == substrate)
+            limit = (self._max_inproc if substrate == "in_process"
+                     else self._max_process)
+            if count >= limit:
+                return None
+            if substrate == "in_process":
+                w = InProcessWorker(self._session, self._max_inline,
+                                    self._reply_handler)
+                self._all[w.worker_id] = w
+                w.leased = True
+                w.is_actor_worker = dedicated
+                return w
+            # Process workers register asynchronously; spawn and let the
+            # dispatcher retry when the hub calls back.
+            pw = ProcessWorker(self._session, self._max_inline, self._hub,
+                               self._worker_registered)
+            self._all[pw.worker_id] = pw
+            return None
+
+    def _worker_registered(self, worker: ProcessWorker) -> None:
+        with self._lock:
+            if worker.alive:
+                self._idle_process.append(worker)
+        self._on_worker_ready()
+
+    def _reap_dead(self) -> None:
+        # lock held
+        cfg = get_config()
+        now = time.monotonic()
+        for w in list(self._all.values()):
+            if isinstance(w, ProcessWorker) and not w.ready:
+                if w.proc.poll() is not None or \
+                        now - w.start_time > cfg.worker_start_timeout_s:
+                    w.alive = False
+                    self._all.pop(w.worker_id, None)
+
+    def push_worker(self, worker: BaseWorker) -> None:
+        with self._lock:
+            if not worker.alive:
+                self._all.pop(worker.worker_id, None)
+                return
+            worker.leased = False
+            worker.is_actor_worker = False
+            worker.last_idle = time.monotonic()
+            if worker.kind == "in_process":
+                self._idle_inproc.append(worker)
+            else:
+                self._idle_process.append(worker)
+        self._on_worker_ready()
+
+    def remove_worker(self, worker: BaseWorker) -> None:
+        with self._lock:
+            worker.alive = False
+            self._all.pop(worker.worker_id, None)
+            if worker in self._idle_process:
+                self._idle_process.remove(worker)
+
+    # -- io ----------------------------------------------------------------
+
+    def process_connections(self) -> List:
+        with self._lock:
+            return [w.conn for w in self._all.values()
+                    if isinstance(w, ProcessWorker) and w.alive
+                    and w.conn is not None]
+
+    def worker_by_conn(self, conn) -> Optional[ProcessWorker]:
+        with self._lock:
+            for w in self._all.values():
+                if isinstance(w, ProcessWorker) and w.conn is conn:
+                    return w
+        return None
+
+    def ensure_function(self, worker: BaseWorker, function_id: bytes,
+                        blob_provider: Callable[[], bytes]) -> None:
+        if function_id not in worker.known_functions:
+            worker.send(("func", function_id, blob_provider()))
+            worker.known_functions.add(function_id)
+
+    def prestart(self, n: int) -> None:
+        with self._lock:
+            existing = sum(1 for w in self._all.values()
+                           if w.alive and w.kind == "process")
+            for _ in range(max(0, min(n, self._max_process) - existing)):
+                pw = ProcessWorker(self._session, self._max_inline,
+                                   self._hub, self._worker_registered)
+                self._all[pw.worker_id] = pw
+
+    def shutdown(self) -> None:
+        with self._lock:
+            workers = list(self._all.values())
+            self._all.clear()
+            self._idle_process.clear()
+            self._idle_inproc.clear()
+        for w in workers:
+            try:
+                w.send(("shutdown",))
+            except Exception:
+                pass
+        deadline = time.monotonic() + 2.0
+        for w in workers:
+            if isinstance(w, ProcessWorker):
+                try:
+                    w.proc.wait(max(0.05, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    w.kill()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "total": len(self._all),
+                "idle_process": len(self._idle_process),
+                "idle_in_process": len(self._idle_inproc),
+            }

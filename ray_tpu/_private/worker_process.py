@@ -1,0 +1,213 @@
+"""Worker-process main loop + the shared task-execution core.
+
+Reference analog: the task-execution callback in ``python/ray/_raylet.pyx``
+(``execute_task``) plus ``core_worker/transport/task_receiver.cc``
+[UNVERIFIED — mount empty, SURVEY.md §0].
+
+Two execution substrates share this code:
+
+- **Process workers** (this module's ``worker_main``): spawned
+  subprocesses for CPU-demand tasks. They import jax lazily and with
+  ``JAX_PLATFORMS=cpu`` — on TPU hosts exactly one process may own the
+  chips, so subprocesses never touch them.
+- **In-process workers**: tasks/actors that demand TPU run on threads
+  inside the driver/host process, which owns the TPU runtime. jax
+  dispatch releases the GIL while the device computes, so threads are
+  the idiomatic host-side concurrency for device work. See
+  ``worker_pool.InProcessWorker``.
+
+Wire protocol (pickled tuples over a multiprocessing Pipe):
+  driver -> worker:
+    ("func", function_id, blob)                 cache a callable
+    ("exec", payload)                           run a normal task
+    ("create_actor", payload)                   instantiate actor
+    ("exec_actor", payload)                     run actor method (ordered)
+    ("shutdown",)
+  worker -> driver:
+    ("ready", pid)
+    ("done", task_id, [(oid, kind, data, contained_refs)], err)
+        kind: "inline" -> data = serialized blob
+              "shm"    -> data = (segment_name, size)
+    ("actor_ready", actor_id, err)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import (
+    ShmClient,
+    _segment_name,
+    create_segment,
+)
+from ray_tpu.exceptions import TaskError
+
+
+class ExecutionEnv:
+    """Per-worker execution state: function cache, shm access, session."""
+
+    def __init__(self, session: str, max_inline_bytes: int):
+        self.session = session
+        self.max_inline_bytes = max_inline_bytes
+        self.functions: Dict[bytes, Callable] = {}
+        self.actors: Dict[bytes, Any] = {}
+        self.shm_client = ShmClient(session)
+        self.serde = serialization.get_context()
+        self.current_task_name = ""
+
+    # -- argument resolution ----------------------------------------------
+
+    def resolve_args(self, arg_descs: List[tuple], kwargs_keys: List[str]
+                     ) -> Tuple[list, dict]:
+        values = [self._resolve_arg(d) for d in arg_descs]
+        if kwargs_keys:
+            n = len(kwargs_keys)
+            pos, kw_vals = values[:-n], values[-n:]
+            return pos, dict(zip(kwargs_keys, kw_vals))
+        return values, {}
+
+    def _resolve_arg(self, desc: tuple):
+        kind = desc[0]
+        if kind == "v":  # inline serialized value
+            value, _refs = self.serde.deserialize_from_blob(memoryview(desc[1]))
+            return value
+        if kind == "shm":  # zero-copy read from the node store
+            _oid, segment_name, size = desc[1], desc[2], desc[3]
+            blob = self.shm_client.read(segment_name, size)
+            value, _refs = self.serde.deserialize_from_blob(blob)
+            return value
+        raise ValueError(f"bad arg descriptor {kind!r}")
+
+    # -- result storage ----------------------------------------------------
+
+    def store_results(self, return_ids: List[bytes], values: tuple
+                      ) -> List[tuple]:
+        out = []
+        for oid_bytes, value in zip(return_ids, values):
+            ser = self.serde.serialize(value)
+            contained = [r.binary() for r in ser.contained_refs]
+            size = ser.size_with_header()
+            if size <= self.max_inline_bytes:
+                out.append((oid_bytes, "inline", ser.to_bytes(), contained))
+            else:
+                oid = ObjectID(oid_bytes)
+                name = _segment_name(self.session, oid)
+                seg = create_segment(name, size)
+                try:
+                    ser.write_into(seg.buf)
+                finally:
+                    seg.close()  # driver adopts the segment by name
+                out.append((oid_bytes, "shm", (name, size), contained))
+        return out
+
+    # -- task execution ----------------------------------------------------
+
+    def execute(self, payload: dict) -> tuple:
+        """Run one task payload; returns a ("done", ...) message."""
+        task_id = payload["task_id"]
+        try:
+            fn = self._get_callable(payload)
+            args, kwargs = self.resolve_args(payload["args"],
+                                             payload["kwargs_keys"])
+            self.current_task_name = payload.get("name", "")
+            if payload["type"] == "create_actor":
+                instance = fn(*args, **kwargs)
+                self.actors[payload["actor_id"]] = instance
+                return ("actor_ready", payload["actor_id"], None)
+            if payload["type"] == "exec_actor":
+                instance = self.actors[payload["actor_id"]]
+                method = getattr(instance, payload["method"])
+                result = method(*args, **kwargs)
+            else:
+                result = fn(*args, **kwargs)
+            n = payload["num_returns"]
+            values = (result,) if n == 1 else tuple(result) if n > 0 else ()
+            if n > 1 and len(values) != n:
+                raise ValueError(
+                    f"task declared num_returns={n} but returned "
+                    f"{len(values)} values")
+            results = self.store_results(payload["return_ids"], values)
+            return ("done", task_id, results, None)
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, task_repr=payload.get("name", "?"),
+                            traceback_str=traceback.format_exc())
+            try:
+                blob = self.serde.serialize(err).to_bytes()
+            except Exception:
+                blob = self.serde.serialize(
+                    TaskError(None, payload.get("name", "?"),
+                              traceback.format_exc())).to_bytes()
+            if payload["type"] == "create_actor":
+                return ("actor_ready", payload["actor_id"], blob)
+            return ("done", task_id, [], blob)
+
+    def _get_callable(self, payload: dict) -> Callable:
+        fid = payload["function_id"]
+        fn = self.functions.get(fid)
+        if fn is None:
+            raise RuntimeError(f"function {fid.hex()} not cached on worker")
+        return fn
+
+    def cache_function(self, function_id: bytes, blob: bytes) -> None:
+        import cloudpickle
+        self.functions[function_id] = cloudpickle.loads(blob)
+
+
+def worker_main(conn, session: str, max_inline_bytes: int,
+                env_vars: Optional[dict] = None) -> None:
+    """Message loop of a process worker (conn already registered)."""
+    if env_vars:
+        os.environ.update(env_vars)
+
+    env = ExecutionEnv(session, max_inline_bytes)
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                break
+            op = msg[0]
+            if op == "shutdown":
+                break
+            elif op == "func":
+                env.cache_function(msg[1], msg[2])
+            elif op in ("exec", "create_actor", "exec_actor"):
+                reply = env.execute(msg[1])
+                conn.send(reply)
+            elif op == "ping":
+                conn.send(("pong",))
+    finally:
+        env.shm_client.close()
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
+def _standalone_main() -> None:
+    """``python -m ray_tpu._private.worker_process`` entry: connect back
+    to the node's hub socket and serve tasks."""
+    import argparse
+
+    from multiprocessing.connection import Client
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", required=True)
+    parser.add_argument("--token", required=True)
+    parser.add_argument("--session", required=True)
+    parser.add_argument("--max-inline", type=int, required=True)
+    args = parser.parse_args()
+
+    conn = Client(args.address, "AF_UNIX")
+    conn.send(("register", args.token, os.getpid()))
+    worker_main(conn, args.session, args.max_inline)
+
+
+if __name__ == "__main__":
+    _standalone_main()
+

@@ -1,0 +1,163 @@
+"""Actor API: ActorClass / ActorHandle / ActorMethod.
+
+Reference: ``python/ray/actor.py`` [UNVERIFIED — mount empty,
+SURVEY.md §0].
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.ids import ActorID
+from ray_tpu._private.task_spec import TaskOptions
+from ray_tpu._private.worker import global_worker
+
+_ACTOR_OPTION_KEYS = {
+    "num_cpus", "num_tpus", "num_gpus", "memory", "resources",
+    "max_restarts", "max_task_retries", "max_concurrency", "name",
+    "namespace", "lifetime", "scheduling_strategy", "runtime_env",
+    "get_if_exists", "placement_group", "placement_group_bundle_index",
+}
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    _METHOD_OPTION_KEYS = {"num_returns", "name"}
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, {})
+
+    def options(self, **overrides):
+        bad = set(overrides) - self._METHOD_OPTION_KEYS
+        if bad:
+            raise ValueError(
+                f"invalid actor-method option(s): {sorted(bad)}; "
+                f"supported: {sorted(self._METHOD_OPTION_KEYS)}")
+        method = self
+
+        class _Bound:
+            def remote(self, *args, **kwargs):  # noqa: ANN001
+                return method._remote(args, kwargs, overrides)
+
+        return _Bound()
+
+    def _remote(self, args, kwargs, overrides):
+        opts = TaskOptions(
+            num_returns=overrides.get("num_returns", self._num_returns))
+        refs = global_worker().submit_actor_task(
+            self._handle._actor_id, self._method_name, args, kwargs, opts)
+        if opts.num_returns == 1:
+            return refs[0]
+        return refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str,
+                 method_names: tuple):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._method_names = method_names
+
+    def __getattr__(self, item: str):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        if item not in self._method_names:
+            raise AttributeError(
+                f"actor {self._class_name} has no method {item!r}")
+        return ActorMethod(self, item)
+
+    def __repr__(self):
+        return (f"ActorHandle({self._class_name}, "
+                f"{self._actor_id.hex()[:12]})")
+
+    def __reduce__(self):
+        return (ActorHandle,
+                (self._actor_id, self._class_name, self._method_names))
+
+
+class ActorClass:
+    def __init__(self, cls: type, **default_options):
+        bad = set(default_options) - _ACTOR_OPTION_KEYS
+        if bad:
+            raise ValueError(f"invalid actor option(s): {sorted(bad)}")
+        self._cls = cls
+        self._defaults = default_options
+        self._descriptor = None
+        self._descriptor_session = None
+
+    def _get_descriptor(self):
+        w = global_worker()
+        if self._descriptor is None or self._descriptor_session != w.session:
+            self._descriptor = w.register_function(self._cls)
+            self._descriptor_session = w.session
+        return self._descriptor
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._remote(args, kwargs, self._defaults)
+
+    def options(self, **overrides):
+        bad = set(overrides) - _ACTOR_OPTION_KEYS
+        if bad:
+            raise ValueError(f"invalid actor option(s): {sorted(bad)}")
+        parent = self
+
+        class _Bound:
+            def remote(self, *args, **kwargs):  # noqa: ANN001
+                merged = dict(parent._defaults)
+                merged.update(overrides)
+                return parent._remote(args, kwargs, merged)
+
+        return _Bound()
+
+    def _remote(self, args, kwargs, options_dict) -> ActorHandle:
+        opts = TaskOptions(**{k: v for k, v in options_dict.items()
+                              if k in TaskOptions.__dataclass_fields__})
+        from ray_tpu.util.scheduling_strategies import (
+            apply_placement_group_option)
+        apply_placement_group_option(opts)
+        w = global_worker()
+        if opts.get_if_exists and opts.name:
+            info = w.gcs.get_named_actor(opts.name,
+                                         opts.namespace or "default")
+            if info is not None and info.state != "DEAD":
+                return ActorHandle(info.actor_id, info.class_name,
+                                   self._method_names())
+        actor_id = w.create_actor(
+            self._get_descriptor(), args, kwargs, opts,
+            class_name=self._cls.__name__)
+        return ActorHandle(actor_id, self._cls.__name__,
+                           self._method_names())
+
+    def _method_names(self) -> tuple:
+        return tuple(name for name in dir(self._cls)
+                     if callable(getattr(self._cls, name, None))
+                     and not name.startswith("__"))
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__} cannot be instantiated "
+            f"directly; use {self._cls.__name__}.remote()")
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    info = global_worker().gcs.get_named_actor(name, namespace)
+    if info is None or info.state == "DEAD":
+        raise ValueError(f"no live actor named {name!r}")
+    spec = info.creation_spec
+    # Method names are derivable from the registered class on the driver.
+    import cloudpickle
+    cls = cloudpickle.loads(
+        global_worker()._get_function_blob(spec.function.function_id))
+    methods = tuple(n for n in dir(cls)
+                    if callable(getattr(cls, n, None))
+                    and not n.startswith("__"))
+    return ActorHandle(info.actor_id, info.class_name, methods)
+
+
+def kill(handle: ActorHandle) -> None:
+    global_worker().kill_actor(handle._actor_id)

@@ -1,0 +1,59 @@
+"""Cluster test utility: N logical nodes on one machine.
+
+Reference: ``python/ray/cluster_utils.py`` (``Cluster`` spins up N real
+raylets as local processes with fake resources) [UNVERIFIED — mount
+empty, SURVEY.md §0]. Here a node = a `Raylet` object with its own
+worker pool and resource ledger inside the host process; the scheduler
+treats them exactly like remote nodes (SURVEY.md §4 implication).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ray_tpu._private.gcs import NodeInfo
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.scheduler.resources import NodeResources
+from ray_tpu._private.worker import Worker, global_worker, init, shutdown
+
+
+class Cluster:
+    def __init__(self, head_num_cpus: float = 4,
+                 head_resources: Optional[Dict[str, float]] = None,
+                 **kwargs):
+        self._worker: Worker = init(num_cpus=head_num_cpus,
+                                    resources=head_resources, **kwargs)
+        self.head_node_id = self._worker.node_group.head_node_id
+
+    def add_node(self, num_cpus: float = 4, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 max_process_workers: int = 2) -> NodeID:
+        total = {"CPU": float(num_cpus)}
+        if num_tpus:
+            total["TPU"] = float(num_tpus)
+        if resources:
+            total.update({k: float(v) for k, v in resources.items()})
+        node_id = NodeID.from_random()
+        w = self._worker
+        raylet = w.node_group.add_node(
+            node_id, NodeResources(total=dict(total),
+                                   available=dict(total)),
+            labels=labels)
+        raylet.worker_pool._max_process = max_process_workers
+        w.gcs.register_node(NodeInfo(node_id=node_id,
+                                     resources_total=dict(total),
+                                     labels=labels or {}))
+        w.node_group.recheck_infeasible()
+        return node_id
+
+    def remove_node(self, node_id: NodeID) -> None:
+        self._worker.node_group.remove_node(node_id)
+        self._worker.gcs.remove_node(node_id)
+
+    @property
+    def worker(self) -> Worker:
+        return self._worker
+
+    def shutdown(self) -> None:
+        shutdown()

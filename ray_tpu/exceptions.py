@@ -1,0 +1,117 @@
+"""Public exception hierarchy (reference: ``python/ray/exceptions.py``
+[UNVERIFIED — mount empty, SURVEY.md §0])."""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """An application-level exception raised inside a task.
+
+    Wraps the original traceback text; re-raised at every ``get`` on the
+    task's return refs and propagated through dependent tasks.
+    """
+
+    def __init__(self, cause: Optional[BaseException] = None,
+                 task_repr: str = "", traceback_str: str = ""):
+        if not isinstance(cause, BaseException):
+            cause = None
+        self.cause = cause
+        self.task_repr = task_repr
+        self.traceback_str = traceback_str or (
+            "".join(traceback.format_exception(cause)) if cause else "")
+        super().__init__(self.traceback_str)
+
+    def __reduce__(self):
+        # The cause may itself be unpicklable; drop it in that case (the
+        # traceback text carries the information either way).
+        import pickle
+        cause = self.cause
+        try:
+            pickle.dumps(cause)
+        except Exception:
+            cause = None
+        return (TaskError, (cause, self.task_repr, self.traceback_str))
+
+    def __str__(self):
+        return (f"Task failed: {self.task_repr}\n"
+                f"{self.traceback_str}")
+
+    def as_instanceof_cause(self) -> BaseException:
+        """Best-effort: return an exception that is also an instance of
+        the user's exception type so `except UserError` works across the
+        task boundary."""
+        if self.cause is None:
+            return self
+        cause_cls = type(self.cause)
+        if cause_cls in (TaskError,) or issubclass(cause_cls, RayTpuError):
+            return self
+        try:
+            derived = type("TaskError_" + cause_cls.__name__,
+                           (TaskError, cause_cls), {})
+            err = derived(self.cause, self.task_repr, self.traceback_str)
+            return err
+        except Exception:
+            return self
+
+
+# Back-compat alias matching the reference's name.
+RayTaskError = TaskError
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead (crashed, killed, or out of restarts)."""
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (restarting)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """`get` exceeded its timeout."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object can no longer be found or reconstructed."""
+
+    def __init__(self, object_id_hex: str, msg: str = ""):
+        self.object_id_hex = object_id_hex
+        super().__init__(msg or f"Object {object_id_hex} was lost")
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    """The owner of this object died; ownership is not replicated."""
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass

@@ -1,0 +1,40 @@
+"""Test fixtures.
+
+Mirrors the reference's test strategy (SURVEY.md §4): tests run against
+a fake device mesh — jax on CPU with
+``--xla_force_host_platform_device_count=8`` — the analog of the
+reference's fake-resource test clusters, so multi-chip sharding logic
+is exercised without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("RAY_TPU_FAKE_TPUS", "8")
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    """A small single-host runtime (2 process workers, 8 fake TPUs)."""
+    import ray_tpu
+    w = ray_tpu.init(num_cpus=4, num_tpus=8, max_process_workers=2)
+    yield w
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-(logical-)node runtime: head + helper for adding nodes."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_num_cpus=4)
+    yield cluster
+    cluster.shutdown()

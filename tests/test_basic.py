@@ -1,0 +1,183 @@
+"""Core task API tests (reference analog: python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskError
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b):
+        return a + b
+
+    assert ray_tpu.get(f.remote(1, 2)) == 3
+
+
+def test_kwargs_and_options(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=0):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1)) == 11
+    assert ray_tpu.get(f.remote(1, b=2, c=3)) == 6
+    assert ray_tpu.get(f.options(num_cpus=2).remote(1)) == 11
+
+
+def test_task_dependencies(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    r = f.remote(0)
+    for _ in range(5):
+        r = f.remote(r)
+    assert ray_tpu.get(r) == 6
+
+
+def test_tree_reduce_dag(ray_start_regular):
+    """BASELINE.json config 2 (miniature): recursive tree reduce."""
+
+    @ray_tpu.remote
+    def leaf(i):
+        return i
+
+    @ray_tpu.remote
+    def combine(a, b):
+        return a + b
+
+    refs = [leaf.remote(i) for i in range(16)]
+    while len(refs) > 1:
+        refs = [combine.remote(refs[i], refs[i + 1])
+                for i in range(0, len(refs), 2)]
+    assert ray_tpu.get(refs[0]) == sum(range(16))
+
+
+def test_large_objects_shm(ray_start_regular):
+    @ray_tpu.remote
+    def make():
+        return np.arange(500_000, dtype=np.float64)
+
+    @ray_tpu.remote
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = make.remote()
+    total = ray_tpu.get(consume.remote(ref))
+    assert total == float(np.arange(500_000).sum())
+
+
+def test_put_get_roundtrip(ray_start_regular):
+    obj = {"k": np.ones(10), "s": "hello"}
+    ref = ray_tpu.put(obj)
+    out = ray_tpu.get(ref)
+    assert out["s"] == "hello"
+    np.testing.assert_array_equal(out["k"], np.ones(10))
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_error_propagation(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def bad():
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError):
+        ray_tpu.get(bad.remote())
+    with pytest.raises(TaskError):
+        ray_tpu.get(bad.remote())
+
+
+def test_dependent_task_error(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def bad():
+        raise RuntimeError("upstream")
+
+    @ray_tpu.remote
+    def dependent(x):
+        return x
+
+    with pytest.raises(RuntimeError):
+        ray_tpu.get(dependent.remote(bad.remote()))
+
+
+def test_retry_on_app_error(ray_start_regular):
+    @ray_tpu.remote
+    class FlakyState:
+        def __init__(self):
+            self.calls = 0
+
+        def incr(self):
+            self.calls += 1
+            return self.calls
+
+    state = FlakyState.remote()
+
+    @ray_tpu.remote(max_retries=2, retry_exceptions=True)
+    def flaky(s):
+        import ray_tpu as rt
+        n = rt.get(s.incr.remote()) if False else None  # noqa: F841
+        raise ValueError("always fails")
+
+    with pytest.raises(ValueError):
+        ray_tpu.get(flaky.remote(1))
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def quick(i):
+        return i
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    refs = [quick.remote(i) for i in range(4)] + [slow.remote()]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=4, timeout=20)
+    assert len(ready) == 4
+    assert len(not_ready) == 1
+
+
+def test_nested_object_refs(ray_start_regular):
+    @ray_tpu.remote
+    def make():
+        return 7
+
+    inner = make.remote()
+    ref = ray_tpu.put({"inner": inner})
+    out = ray_tpu.get(ref)
+    assert ray_tpu.get(out["inner"]) == 7
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0
+    assert res["TPU"] == 8.0
+
+
+def test_many_small_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(i):
+        return i * i
+
+    refs = [sq.remote(i) for i in range(200)]
+    assert sum(ray_tpu.get(refs)) == sum(i * i for i in range(200))

@@ -1,0 +1,43 @@
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+)
+
+
+def test_sizes_and_roundtrip():
+    job = JobID.from_int(7)
+    assert len(job.binary()) == 4
+    actor = ActorID.of(job)
+    assert actor.job_id() == job
+    task = TaskID.of(actor)
+    assert task.actor_id() == actor
+    assert task.job_id() == job
+    oid = ObjectID.from_index(task, 3)
+    assert oid.task_id() == task
+    assert oid.index() == 3
+    assert not oid.is_put()
+
+
+def test_put_index_space_disjoint():
+    task = TaskID.for_normal_task(JobID.from_int(1))
+    ret = ObjectID.from_index(task, 1)
+    put = ObjectID.for_put(task, 1)
+    assert ret != put
+    assert put.is_put()
+
+
+def test_hex_roundtrip_equality_hash():
+    n = NodeID.from_random()
+    assert NodeID.from_hex(n.hex()) == n
+    assert hash(NodeID.from_hex(n.hex())) == hash(n)
+    assert n != NodeID.from_random()
+    assert NodeID.nil().is_nil()
+
+
+def test_ids_pickle():
+    import pickle
+    t = TaskID.for_normal_task(JobID.from_int(2))
+    assert pickle.loads(pickle.dumps(t)) == t

@@ -1,0 +1,91 @@
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import JobID, ObjectID, TaskID
+from ray_tpu._private.object_store import (
+    MemoryStore,
+    ObjectStoreFullError,
+    ShmClient,
+    ShmStore,
+)
+
+
+def _oid(i: int) -> ObjectID:
+    return ObjectID.from_index(TaskID.for_normal_task(JobID.from_int(1)), i)
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = ShmStore("testsess", capacity_bytes=1 << 20,
+                 spill_dir=str(tmp_path), spill_threshold=0.8)
+    yield s
+    s.shutdown()
+
+
+def test_create_seal_get(store):
+    oid = _oid(1)
+    buf = store.create(oid, 100)
+    buf[:5] = b"hello"
+    store.seal(oid)
+    assert store.contains(oid)
+    view = store.get_local(oid)
+    assert bytes(view[:5]) == b"hello"
+    del buf, view
+
+
+def test_reader_attach(store):
+    oid = _oid(2)
+    store.put_blob(oid, b"shared-data")
+    name, size = store.segment_for(oid)
+    client = ShmClient("testsess")
+    data = client.read(name, size)
+    assert bytes(data) == b"shared-data"
+    del data
+    client.close()
+
+
+def test_spill_and_restore(store):
+    blobs = {}
+    for i in range(20):
+        oid = _oid(10 + i)
+        payload = bytes([i]) * 100_000
+        blobs[oid] = payload
+        store.put_blob(oid, payload)
+    assert store.num_spilled > 0
+    # every object still readable (restored on demand)
+    for oid, payload in blobs.items():
+        view = store.get_local(oid)
+        assert bytes(view[:10]) == payload[:10]
+        del view
+    assert store.num_restored > 0
+
+
+def test_store_full(store):
+    with pytest.raises(ObjectStoreFullError):
+        store.create(_oid(99), 2 << 20)
+
+
+def test_free(store):
+    oid = _oid(3)
+    store.put_blob(oid, b"x" * 100)
+    store.free(oid)
+    assert not store.contains(oid)
+    assert store.get_local(oid) is None
+
+
+def test_memory_store_wait():
+    import threading
+    ms = MemoryStore()
+    oids = [_oid(i) for i in range(5)]
+    ready, not_ready = ms.wait(oids, num_returns=1, timeout=0.05)
+    assert len(ready) == 0 and len(not_ready) == 5
+
+    def putter():
+        for o in oids[:3]:
+            ms.put(o, "v")
+
+    t = threading.Thread(target=putter)
+    t.start()
+    ready, not_ready = ms.wait(oids, num_returns=3, timeout=5)
+    t.join()
+    assert len(ready) == 3 and len(not_ready) == 2

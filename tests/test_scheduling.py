@@ -1,0 +1,141 @@
+"""Scheduling policy unit tests + multi-(logical-)node placement.
+
+Reference analog: ``src/ray/raylet/scheduling/*_test.cc`` +
+``python/ray/tests/test_scheduling*.py``.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.scheduler.policy import (
+    HybridSchedulingPolicy,
+    RandomSchedulingPolicy,
+    SchedulingRequest,
+    SpreadSchedulingPolicy,
+)
+from ray_tpu._private.scheduler.resources import (
+    ClusterResourceManager,
+    NodeResources,
+)
+
+
+def make_cluster(specs):
+    mgr = ClusterResourceManager()
+    ids = []
+    for total in specs:
+        nid = NodeID.from_random()
+        mgr.add_or_update_node(nid, NodeResources.of(**total))
+        ids.append(nid)
+    return mgr, ids
+
+
+class TestHybridPolicy:
+    def test_prefers_local_below_threshold(self):
+        mgr, ids = make_cluster([{"CPU": 8}, {"CPU": 8}])
+        pol = HybridSchedulingPolicy(spread_threshold=0.5)
+        res = pol.schedule(mgr, SchedulingRequest({"CPU": 1},
+                                                  preferred_node=ids[0]))
+        assert res.node_id == ids[0]
+
+    def test_spreads_above_threshold(self):
+        mgr, ids = make_cluster([{"CPU": 8}, {"CPU": 8}])
+        # local node 60% utilized -> above 0.5 threshold
+        mgr.allocate(ids[0], {"CPU": 5})
+        pol = HybridSchedulingPolicy(spread_threshold=0.5, seed=0)
+        res = pol.schedule(mgr, SchedulingRequest({"CPU": 1},
+                                                  preferred_node=ids[0]))
+        assert res.node_id == ids[1]
+
+    def test_infeasible(self):
+        mgr, ids = make_cluster([{"CPU": 2}])
+        pol = HybridSchedulingPolicy()
+        res = pol.schedule(mgr, SchedulingRequest({"GPU": 1}))
+        assert res.node_id is None
+        assert res.is_infeasible
+
+    def test_unavailable_not_infeasible(self):
+        mgr, ids = make_cluster([{"CPU": 2}])
+        mgr.allocate(ids[0], {"CPU": 2})
+        pol = HybridSchedulingPolicy()
+        res = pol.schedule(mgr, SchedulingRequest({"CPU": 1}))
+        assert res.node_id is None
+        assert not res.is_infeasible
+
+    def test_batch_spreads_load(self):
+        mgr, ids = make_cluster([{"CPU": 2}, {"CPU": 2}, {"CPU": 2}])
+        pol = HybridSchedulingPolicy(spread_threshold=0.5, seed=1)
+        reqs = [SchedulingRequest({"CPU": 1}, preferred_node=ids[0])
+                for _ in range(6)]
+        results = pol.schedule_batch(mgr, reqs)
+        chosen = [r.node_id for r in results]
+        assert all(c is not None for c in chosen)
+        # 6 one-cpu tasks over 3 two-cpu nodes must use all nodes
+        assert len(set(chosen)) == 3
+
+    def test_custom_resources(self):
+        mgr, ids = make_cluster([{"CPU": 4}, {"CPU": 4, "accel": 2}])
+        pol = HybridSchedulingPolicy()
+        res = pol.schedule(mgr, SchedulingRequest({"accel": 1}))
+        assert res.node_id == ids[1]
+
+
+class TestOtherPolicies:
+    def test_spread_round_robin(self):
+        mgr, ids = make_cluster([{"CPU": 4}] * 4)
+        pol = SpreadSchedulingPolicy()
+        reqs = [SchedulingRequest({"CPU": 1}) for _ in range(4)]
+        chosen = {r.node_id for r in pol.schedule_batch(mgr, reqs)}
+        assert len(chosen) == 4
+
+    def test_random_feasibility(self):
+        mgr, ids = make_cluster([{"CPU": 1}, {"GPU": 1, "CPU": 1}])
+        pol = RandomSchedulingPolicy(seed=0)
+        for _ in range(5):
+            res = pol.schedule(mgr.__class__() if False else mgr,
+                               SchedulingRequest({"GPU": 1}))
+            assert res.node_id == ids[1]
+            mgr.free(ids[1], {"GPU": 1})
+
+
+class TestClusterPlacement:
+    def test_custom_resource_routes_to_node(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        cluster.add_node(num_cpus=2, resources={"special": 1})
+
+        @ray_tpu.remote(num_cpus=1, resources={"special": 1})
+        def where():
+            import os
+            return os.getpid()
+
+        # must run (only the added node has "special")
+        assert isinstance(ray_tpu.get(where.remote()), int)
+
+    def test_infeasible_becomes_feasible(self, ray_start_cluster):
+        cluster = ray_start_cluster
+
+        @ray_tpu.remote(resources={"late": 1})
+        def waits():
+            return "ran"
+
+        ref = waits.remote()
+        import time
+        time.sleep(0.3)
+        cluster.add_node(num_cpus=2, resources={"late": 1})
+        assert ray_tpu.get(ref, timeout=60) == "ran"
+
+    def test_node_death_task_retry(self, ray_start_cluster):
+        cluster = ray_start_cluster
+        nid = cluster.add_node(num_cpus=2, resources={"doomed": 1})
+
+        @ray_tpu.remote(resources={"doomed": 1}, max_retries=0)
+        def trapped():
+            import time
+            time.sleep(60)
+
+        ref = trapped.remote()
+        import time
+        time.sleep(1.0)
+        cluster.remove_node(nid)
+        with pytest.raises(Exception):
+            ray_tpu.get(ref, timeout=30)

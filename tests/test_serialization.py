@@ -1,0 +1,47 @@
+import numpy as np
+
+from ray_tpu._private import serialization
+
+
+def test_roundtrip_scalars_and_containers():
+    ctx = serialization.get_context()
+    for value in [1, "x", None, {"a": [1, 2]}, (1, 2), {1, 2}, b"bytes"]:
+        ser = ctx.serialize(value)
+        out, refs = ctx.deserialize_from_blob(memoryview(ser.to_bytes()))
+        assert out == value
+        assert refs == []
+
+
+def test_numpy_zero_copy():
+    ctx = serialization.get_context()
+    arr = np.arange(1000, dtype=np.float32)
+    ser = ctx.serialize({"a": arr, "b": 5})
+    assert ser.buffers, "large numpy should go out-of-band"
+    blob = ser.to_bytes()
+    out, _ = ctx.deserialize_from_blob(memoryview(blob))
+    np.testing.assert_array_equal(out["a"], arr)
+    # The deserialized array aliases the blob (zero-copy).
+    assert not out["a"].flags.writeable or out["a"].base is not None
+
+
+def test_write_into_matches_to_bytes():
+    ctx = serialization.get_context()
+    value = {"x": np.ones(512), "y": list(range(100))}
+    ser = ctx.serialize(value)
+    size = ser.size_with_header()
+    buf = bytearray(size)
+    written = ser.write_into(memoryview(buf))
+    assert written == size
+    assert bytes(buf) == ser.to_bytes()
+
+
+def test_closure_serialization():
+    ctx = serialization.get_context()
+    k = 42
+
+    def fn(x):
+        return x + k
+
+    ser = ctx.serialize(fn)
+    out, _ = ctx.deserialize_from_blob(memoryview(ser.to_bytes()))
+    assert out(1) == 43
