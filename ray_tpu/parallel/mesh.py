@@ -1,0 +1,110 @@
+"""Device-mesh and sharding substrate.
+
+This replaces the reference's NCCL/Gloo process-group bootstrap
+(royf/ray ``python/ray/util/collective/`` and Train's c10d setup
+[UNVERIFIED — mount empty, SURVEY.md §0]) with the TPU-native model:
+a named ``jax.sharding.Mesh`` over the device grid, sharding rules as
+PartitionSpec trees, and XLA-compiled collectives over ICI.
+
+Axes follow the scaling-book convention:
+  dp    — pure data parallel (gradient psum over ICI)
+  fsdp  — data parallel with parameter sharding (ZeRO-3 style)
+  tp    — tensor parallel (weight-matrix sharding, activations
+          all-reduced at block boundaries)
+  sp    — sequence/context parallel (ring attention KV rotation)
+  ep    — expert parallel (MoE all-to-all)
+  pp    — pipeline stages
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "pp", "sp", "tp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical parallelism layout. Unset axes default to 1.
+
+    ``ep`` shares devices with (dp, fsdp, sp) in MoE layers rather than
+    occupying its own mesh dimension — the standard TPU MoE layout —
+    so it is validated against, not multiplied into, the device count.
+    """
+
+    dp: int = 1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.pp * self.sp * self.tp
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in ("dp", "fsdp", "pp", "sp", "tp")}
+
+    @staticmethod
+    def auto(n_devices: Optional[int] = None, *,
+             tp: int = 1, sp: int = 1, pp: int = 1) -> "MeshSpec":
+        """Fill the leftover device factor into fsdp."""
+        n = n_devices or len(jax.devices())
+        rest = n // (tp * sp * pp)
+        if rest * tp * sp * pp != n:
+            raise ValueError(f"{n} devices not divisible by tp*sp*pp="
+                             f"{tp * sp * pp}")
+        return MeshSpec(fsdp=rest, tp=tp, sp=sp, pp=pp)
+
+
+def make_mesh(spec: MeshSpec,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    if len(devs) < spec.num_devices:
+        raise ValueError(
+            f"mesh spec needs {spec.num_devices} devices, have {len(devs)}")
+    devs = devs[:spec.num_devices]
+    shape = tuple(spec.axis_sizes().values())
+    grid = np.asarray(devs).reshape(shape)
+    return Mesh(grid, axis_names=tuple(spec.axis_sizes().keys()))
+
+
+# Composite axis groups commonly used in shardings: batch is split over
+# every data-ish axis; model (hidden) dims over tp.
+BATCH_AXES = ("dp", "fsdp")
+DATA_AXES = ("dp", "fsdp", "sp")  # full data extent incl. seq shards
+
+
+def batch_spec() -> P:
+    return P(BATCH_AXES, "sp", None)  # [batch, seq, ...]
+
+
+def shard(mesh: Mesh, x, spec: P):
+    return jax.device_put(x, NamedSharding(mesh, spec))
+
+
+def sharding_for(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def tree_shardings(mesh: Mesh, spec_tree) -> object:
+    """Map a pytree of PartitionSpecs to NamedShardings."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda s: isinstance(s, P))
+
+
+def local_mesh(n: int = 0) -> Mesh:
+    """Mesh over all (or first n) local devices, fsdp-only — the default
+    single-host layout."""
+    devs = jax.devices()
+    n = n or len(devs)
+    return make_mesh(MeshSpec(fsdp=n), devs)

@@ -9,13 +9,22 @@ is exercised without TPU hardware.
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The axon sitecustomize imports jax at interpreter startup and pins
+# JAX_PLATFORMS=axon, so env vars set here are too late; but backends
+# initialize lazily, so jax.config.update still wins if it runs before
+# the first device access. XLA_FLAGS is read at backend init, so
+# setting it here is in time. Set RAY_TPU_TEST_PLATFORM to run the
+# suite on real hardware instead.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("RAY_TPU_FAKE_TPUS", "8")
+
+import jax
+
+jax.config.update("jax_platforms",
+                  os.environ.get("RAY_TPU_TEST_PLATFORM", "cpu"))
 
 import pytest
 

@@ -1,0 +1,180 @@
+"""TPU scheduling-policy kernel tests (run on the fake 8-device CPU
+backend from conftest — same kernel code as real TPU).
+
+Checks semantic parity with HybridSchedulingPolicy: local packing until
+the spread threshold, least-utilization spread, feasibility vs
+availability, never oversubscribing, mixed scheduling classes.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.scheduler.policy import (
+    HybridSchedulingPolicy,
+    SchedulingRequest,
+)
+from ray_tpu._private.scheduler.resources import (
+    ClusterResourceManager,
+    NodeResources,
+)
+from ray_tpu._private.scheduler.tpu_policy import TpuSchedulingPolicy
+
+
+def make_cluster(node_cpus):
+    cluster = ClusterResourceManager()
+    ids = []
+    for cpus in node_cpus:
+        nid = NodeID.from_random()
+        cluster.add_or_update_node(nid, NodeResources.of(CPU=cpus))
+        ids.append(nid)
+    return cluster, ids
+
+
+def test_single_task_prefers_local_node():
+    cluster, ids = make_cluster([4, 4, 4])
+    pol = TpuSchedulingPolicy()
+    res = pol.schedule(cluster, SchedulingRequest(
+        demand={"CPU": 1}, preferred_node=ids[1]))
+    assert res.node_id == ids[1]
+
+
+def test_local_packing_stops_at_spread_threshold():
+    # threshold 0.5 on an 8-CPU node: exactly 4 tasks pack locally.
+    cluster, ids = make_cluster([8, 8])
+    pol = TpuSchedulingPolicy(spread_threshold=0.5)
+    reqs = [SchedulingRequest(demand={"CPU": 1}, preferred_node=ids[0])
+            for _ in range(8)]
+    results = pol.schedule_batch(cluster, reqs)
+    on_local = sum(1 for r in results if r.node_id == ids[0])
+    assert on_local == 4
+    assert all(r.node_id is not None for r in results)
+
+
+def test_never_oversubscribes():
+    cluster, ids = make_cluster([2, 3, 5])
+    pol = TpuSchedulingPolicy()
+    reqs = [SchedulingRequest(demand={"CPU": 1}) for _ in range(30)]
+    results = pol.schedule_batch(cluster, reqs)
+    counts = {}
+    for r in results:
+        if r.node_id is not None:
+            counts[r.node_id] = counts.get(r.node_id, 0) + 1
+    assert sum(counts.values()) == 10          # only 10 CPUs exist
+    assert counts.get(ids[0], 0) <= 2
+    assert counts.get(ids[1], 0) <= 3
+    assert counts.get(ids[2], 0) <= 5
+    # the other 20 are unscheduled but NOT infeasible
+    unscheduled = [r for r in results if r.node_id is None]
+    assert len(unscheduled) == 20
+    assert all(not r.is_infeasible for r in unscheduled)
+
+
+def test_infeasible_flag():
+    cluster, ids = make_cluster([2, 2])
+    pol = TpuSchedulingPolicy()
+    res = pol.schedule(cluster, SchedulingRequest(demand={"CPU": 16}))
+    assert res.node_id is None and res.is_infeasible
+    res = pol.schedule(cluster, SchedulingRequest(demand={"GPU": 1}))
+    assert res.node_id is None and res.is_infeasible
+
+
+def test_dead_node_excluded():
+    cluster, ids = make_cluster([4, 4])
+    node = cluster.get_node(ids[0])
+    node.alive = False
+    cluster.add_or_update_node(ids[0], node)
+    pol = TpuSchedulingPolicy()
+    results = pol.schedule_batch(
+        cluster, [SchedulingRequest(demand={"CPU": 1}) for _ in range(4)])
+    assert all(r.node_id == ids[1] for r in results)
+
+
+def test_mixed_scheduling_classes_share_capacity():
+    cluster, ids = make_cluster([4])
+    cluster.add_or_update_node(
+        ids[0], NodeResources.of(CPU=4, TPU=2))
+    pol = TpuSchedulingPolicy()
+    reqs = ([SchedulingRequest(demand={"CPU": 2}) for _ in range(2)] +
+            [SchedulingRequest(demand={"CPU": 1, "TPU": 1}) for _ in range(4)])
+    results = pol.schedule_batch(cluster, reqs)
+    # 2 CPU-heavy tasks take all 4 CPUs; TPU tasks then lack CPU.
+    assert results[0].node_id == ids[0] and results[1].node_id == ids[0]
+    scheduled_tpu = [r for r in results[2:] if r.node_id is not None]
+    assert len(scheduled_tpu) == 0
+    assert all(not r.is_infeasible for r in results[2:])
+
+
+def test_spreads_to_least_utilized():
+    cluster, ids = make_cluster([10, 10])
+    # preload node 0 to 80% utilization
+    cluster.allocate(ids[0], {"CPU": 8})
+    pol = TpuSchedulingPolicy()
+    results = pol.schedule_batch(
+        cluster, [SchedulingRequest(demand={"CPU": 1}) for _ in range(4)])
+    assert all(r.node_id == ids[1] for r in results)
+
+
+def test_matches_hybrid_totals_on_random_clusters():
+    """Property test: same total scheduled count and no-oversubscribe as
+    the sequential hybrid policy on random workloads."""
+    rng = np.random.RandomState(0)
+    for trial in range(5):
+        n_nodes = int(rng.randint(1, 12))
+        cpus = rng.randint(1, 16, n_nodes).tolist()
+        cluster, ids = make_cluster(cpus)
+        n_tasks = int(rng.randint(1, 64))
+        demand = float(rng.randint(1, 4))
+        reqs = [SchedulingRequest(demand={"CPU": demand})
+                for _ in range(n_tasks)]
+        tpu = TpuSchedulingPolicy().schedule_batch(cluster, reqs)
+        hyb = HybridSchedulingPolicy(seed=0).schedule_batch(cluster, reqs)
+        n_tpu = sum(1 for r in tpu if r.node_id is not None)
+        n_hyb = sum(1 for r in hyb if r.node_id is not None)
+        assert n_tpu == n_hyb, (trial, n_tpu, n_hyb)
+        # per-node caps respected
+        per_node = {}
+        for r in tpu:
+            if r.node_id:
+                per_node[r.node_id] = per_node.get(r.node_id, 0) + 1
+        for nid, c in per_node.items():
+            assert c * demand <= cluster.get_node(nid).total["CPU"] + 1e-6
+
+
+def test_large_batch_single_class_fast_path():
+    cluster, ids = make_cluster([64] * 32)
+    pol = TpuSchedulingPolicy()
+    reqs = [SchedulingRequest(demand={"CPU": 1}) for _ in range(2048)]
+    results = pol.schedule_batch(cluster, reqs)
+    assert sum(1 for r in results if r.node_id is not None) == 2048
+    per_node = {}
+    for r in results:
+        per_node[r.node_id] = per_node.get(r.node_id, 0) + 1
+    assert max(per_node.values()) <= 64
+
+
+def test_balanced_fill_matches_hybrid_placement():
+    """Water-fill phase 2 balances utilization like the sequential
+    hybrid policy (not first-node-takes-all)."""
+    cluster, ids = make_cluster([8, 8, 8])
+    pol = TpuSchedulingPolicy()
+    results = pol.schedule_batch(
+        cluster, [SchedulingRequest(demand={"CPU": 1}) for _ in range(9)])
+    per_node = {}
+    for r in results:
+        per_node[r.node_id] = per_node.get(r.node_id, 0) + 1
+    assert sorted(per_node.values()) == [3, 3, 3], per_node
+    # heterogeneous totals balance by utilization, not by count
+    cluster2, ids2 = make_cluster([12, 4])
+    results = TpuSchedulingPolicy().schedule_batch(
+        cluster2, [SchedulingRequest(demand={"CPU": 1}) for _ in range(8)])
+    counts = {nid: 0 for nid in ids2}
+    for r in results:
+        counts[r.node_id] += 1
+    assert counts[ids2[0]] == 6 and counts[ids2[1]] == 2, counts
+
+
+def test_registry_selection():
+    from ray_tpu._private.scheduler.policy import create_policy
+    pol = create_policy("tpu")
+    assert isinstance(pol, TpuSchedulingPolicy)
