@@ -80,14 +80,15 @@ class DependencyManager:
 
 
 class RunningTask:
-    __slots__ = ("spec", "node_id", "worker", "resources")
+    __slots__ = ("spec", "node_id", "worker", "resources", "pg")
 
     def __init__(self, spec: TaskSpec, node_id: NodeID, worker: BaseWorker,
-                 resources: Dict[str, float]):
+                 resources: Dict[str, float], pg=None):
         self.spec = spec
         self.node_id = node_id
         self.worker = worker
         self.resources = resources
+        self.pg = pg  # (PlacementGroupID, bundle_index) | None
 
 
 class Raylet:
@@ -125,6 +126,8 @@ class NodeManagerGroup:
 
         self.cluster_resources = ClusterResourceManager()
         self.dependency_manager = DependencyManager()
+        self.pg_manager = None  # set by the owning Worker after init
+        self._fail_task_cb = None  # (spec, exception) -> None; set by Worker
 
         self._lock = threading.RLock()
         self._raylets: Dict[NodeID, Raylet] = {}
@@ -178,6 +181,16 @@ class NodeManagerGroup:
             requeue = list(raylet.dispatch_queue)
             raylet.dispatch_queue.clear()
             self._to_schedule.extend(requeue)
+        # Return any bundle draws held by requeued PG tasks so the
+        # rescheduling pass re-draws cleanly, then dissolve groups that
+        # lost a bundle with the node (their gang guarantee is gone).
+        if self.pg_manager is not None:
+            for spec in requeue:
+                pg = self._spec_pg(spec)
+                if pg is not None:
+                    self.pg_manager.free_to_bundle(pg[0], pg[1],
+                                                   spec.resources)
+            self.pg_manager.on_node_removed(node_id)
         self.cluster_resources.remove_node(node_id)
         for tid in dead_tasks:
             self._fail_running(tid, WorkerCrashedError(
@@ -218,10 +231,17 @@ class NodeManagerGroup:
 
     # -- actor task routing ------------------------------------------------
 
+    def _spec_pg(self, spec: TaskSpec):
+        if spec.placement_group_id is not None:
+            return (spec.placement_group_id,
+                    spec.placement_group_bundle_index)
+        return None
+
     def register_actor_worker(self, actor_id: ActorID, node_id: NodeID,
-                              worker: BaseWorker, resources: dict) -> None:
+                              worker: BaseWorker, resources: dict,
+                              pg=None) -> None:
         with self._lock:
-            self._actor_workers[actor_id] = (node_id, worker, resources)
+            self._actor_workers[actor_id] = (node_id, worker, resources, pg)
 
     def set_actor_death_callback(self, cb: Callable) -> None:
         self._actor_death_cb = cb
@@ -237,7 +257,7 @@ class NodeManagerGroup:
             entry = self._actor_workers.get(actor_id)
             if entry is None or not entry[1].alive:
                 return False
-            _, worker, _ = entry
+            _, worker, _, _ = entry
             self._running[spec.task_id] = RunningTask(
                 spec, entry[0], worker, {})
         worker.send(("exec_actor", payload))
@@ -252,7 +272,7 @@ class NodeManagerGroup:
             entry = self._actor_workers.pop(actor_id, None)
         if entry is None:
             return
-        node_id, worker, resources = entry
+        node_id, worker, resources, pg = entry
         if kill_worker:
             worker.send(("shutdown",))
             worker.kill()
@@ -260,7 +280,7 @@ class NodeManagerGroup:
                 raylet = self._raylets.get(node_id)
             if raylet is not None:
                 raylet.worker_pool.remove_worker(worker)
-        self.cluster_resources.free(node_id, resources)
+        self._free_allocation(node_id, resources, pg)
         self._wake.set()
 
     # -- scheduling loop ---------------------------------------------------
@@ -272,10 +292,56 @@ class NodeManagerGroup:
             self._wake.wait(timeout=0.1)
             self._wake.clear()
             try:
+                if self.pg_manager is not None:
+                    self.pg_manager.try_schedule_pending()
                 self._schedule_once(batch_limit)
                 self._dispatch_all()
             except Exception:
                 logger.exception("scheduling loop error")
+
+    def _free_allocation(self, node_id: NodeID, resources: Dict[str, float],
+                         pg=None) -> None:
+        """Return a task/actor allocation: to its placement-group bundle
+        when it was drawn from one, else to the node's free pool."""
+        if pg is not None and self.pg_manager is not None:
+            self.pg_manager.free_to_bundle(pg[0], pg[1], resources)
+        else:
+            self.cluster_resources.free(node_id, resources)
+
+    def _schedule_pg_task(self, spec: TaskSpec, retry: List[TaskSpec]
+                          ) -> None:
+        """Route a task bound to a placement group: draw from the
+        bundle's reservation and pin to the bundle's node."""
+        pg_id = spec.placement_group_id
+        bundle_index = spec.placement_group_bundle_index
+        alloc, reason = self.pg_manager.allocate_from_bundle(
+            pg_id, bundle_index, spec.resources)
+        if alloc is None:
+            if reason in ("pending", "busy"):
+                retry.append(spec)
+            else:
+                err_msg = (
+                    f"placement group {pg_id.hex()[:12]} was removed"
+                    if reason == "removed" else
+                    f"task demand {spec.resources} can never fit bundle "
+                    f"{bundle_index} of placement group {pg_id.hex()[:12]}")
+                if self._fail_task_cb is not None:
+                    from ray_tpu.exceptions import PlacementGroupError
+                    self._fail_task_cb(spec, PlacementGroupError(err_msg))
+                else:
+                    logger.error("dropping pg task %s: %s",
+                                 spec.repr_name(), err_msg)
+            return
+        node_id, resolved_index = alloc
+        spec.placement_group_bundle_index = resolved_index
+        with self._lock:
+            raylet = self._raylets.get(node_id)
+            if raylet is None or not raylet.alive:
+                self.pg_manager.free_to_bundle(pg_id, resolved_index,
+                                               spec.resources)
+                retry.append(spec)
+                return
+            raylet.dispatch_queue.append(spec)
 
     def _schedule_once(self, batch_limit: int) -> None:
         with self._lock:
@@ -284,6 +350,15 @@ class NodeManagerGroup:
                 batch.append(self._to_schedule.popleft())
         if not batch:
             return
+        retry: List[TaskSpec] = []
+        plain: List[TaskSpec] = []
+        for spec in batch:
+            if (spec.placement_group_id is not None
+                    and self.pg_manager is not None):
+                self._schedule_pg_task(spec, retry)
+            else:
+                plain.append(spec)
+        batch = plain
         requests = [
             SchedulingRequest(
                 demand=spec.resources,
@@ -292,8 +367,8 @@ class NodeManagerGroup:
             )
             for spec in batch
         ]
-        results = self._policy.schedule_batch(self.cluster_resources, requests)
-        retry: List[TaskSpec] = []
+        results = self._policy.schedule_batch(
+            self.cluster_resources, requests) if requests else []
         for spec, res in zip(batch, results):
             if res.node_id is None:
                 if res.is_infeasible:
@@ -350,7 +425,8 @@ class NodeManagerGroup:
             err = self._send_task(raylet, worker, spec)
             if err is not None:
                 raylet.worker_pool.push_worker(worker)
-                self.cluster_resources.free(raylet.node_id, spec.resources)
+                self._free_allocation(raylet.node_id, spec.resources,
+                                      self._spec_pg(spec))
                 if isinstance(err, _DependencyError):
                     # Upstream task failed: propagate its error verbatim,
                     # never retry the dependent (reference semantics).
@@ -398,7 +474,8 @@ class NodeManagerGroup:
                 lambda: self._function_blob(spec.function.function_id))
             with self._lock:
                 self._running[spec.task_id] = RunningTask(
-                    spec, raylet.node_id, worker, dict(spec.resources))
+                    spec, raylet.node_id, worker, dict(spec.resources),
+                    pg=self._spec_pg(spec))
             worker.send(("exec" if payload["type"] == "exec"
                          else "create_actor", payload))
             from ray_tpu._private import events
@@ -432,7 +509,7 @@ class NodeManagerGroup:
                     raylet = self._raylets.get(rt.node_id)
                 if raylet is not None:
                     raylet.worker_pool.push_worker(worker)
-                self.cluster_resources.free(rt.node_id, rt.resources)
+                self._free_allocation(rt.node_id, rt.resources, rt.pg)
                 self._wake.set()
             self._complete_task(task_id, results, err_blob, None)
         elif op == "actor_ready":
@@ -455,11 +532,12 @@ class NodeManagerGroup:
                 if raylet is not None:
                     raylet.worker_pool.remove_worker(worker)
                     worker.send(("shutdown",))
-                self.cluster_resources.free(rt.node_id, rt.resources)
+                self._free_allocation(rt.node_id, rt.resources, rt.pg)
                 self._complete_task(task_id, [], err_blob, None)
             else:
                 self.register_actor_worker(
-                    ActorID(actor_id_b), rt.node_id, worker, rt.resources)
+                    ActorID(actor_id_b), rt.node_id, worker, rt.resources,
+                    pg=rt.pg)
                 self._complete_task(task_id, [], None, None)
 
     def _io_loop(self) -> None:
@@ -510,7 +588,7 @@ class NodeManagerGroup:
             for tid, rt in self._running.items():
                 if rt.worker is worker:
                     dead.append(tid)
-            for aid, (nid, w, res) in list(self._actor_workers.items()):
+            for aid, (nid, w, res, _pg) in list(self._actor_workers.items()):
                 if w is worker:
                     dead_actor = aid
         for tid in dead:
@@ -520,8 +598,8 @@ class NodeManagerGroup:
             with self._lock:
                 entry = self._actor_workers.pop(dead_actor, None)
             if entry is not None:
-                nid, _, res = entry
-                self.cluster_resources.free(nid, res)
+                nid, _, res, pg = entry
+                self._free_allocation(nid, res, pg)
                 if self._actor_death_cb is not None:
                     self._actor_death_cb(dead_actor)
         self._wake.set()
@@ -532,7 +610,7 @@ class NodeManagerGroup:
         if rt is None:
             return
         if not rt.worker.is_actor_worker and rt.resources:
-            self.cluster_resources.free(rt.node_id, rt.resources)
+            self._free_allocation(rt.node_id, rt.resources, rt.pg)
         self._complete_task(task_id, [], None, err)
 
     # -- lifecycle ---------------------------------------------------------

@@ -124,6 +124,15 @@ class Worker:
             driver_node_resources=node_res,
             max_process_workers=max_process_workers)
         self.node_group.set_actor_death_callback(self._on_actor_death)
+
+        from ray_tpu._private.placement_group_manager import (
+            PlacementGroupManager)
+        self.pg_manager = PlacementGroupManager(
+            self.node_group.cluster_resources,
+            on_created=self._on_pg_created)
+        self.node_group.pg_manager = self.pg_manager
+        self.node_group._fail_task_cb = self._fail_task
+        self._pg_ready_refs: Dict[Any, ObjectID] = {}
         self.gcs.register_node(NodeInfo(
             node_id=self.node_group.head_node_id,
             resources_total=dict(total)))
@@ -321,11 +330,31 @@ class Worker:
             name=options.name or fn_descriptor.repr_name(),
             return_ids=return_ids,
         )
+        self._apply_pg_strategy(spec, options)
         for oid in return_ids:
             self.reference_counter.add_owned_object(oid)
         self.task_manager.add_pending_task(spec)
         self.node_group.submit_task(spec)
         return [ObjectRef(oid) for oid in return_ids]
+
+    def _apply_pg_strategy(self, spec: TaskSpec, options: TaskOptions
+                           ) -> None:
+        """Bind the spec to a placement-group bundle (explicit strategy,
+        or inherited from a capturing driver-side PG context)."""
+        strat = options.scheduling_strategy
+        if getattr(strat, "kind", None) == "PLACEMENT_GROUP":
+            pg = strat.placement_group
+            spec.placement_group_id = pg.id
+            spec.placement_group_bundle_index = \
+                strat.placement_group_bundle_index
+            return
+        if strat is None:
+            from ray_tpu.util.placement_group import (
+                get_current_placement_group)
+            pg = get_current_placement_group()
+            if pg is not None and pg.capture_child_tasks:
+                spec.placement_group_id = pg.id
+                spec.placement_group_bundle_index = -1
 
     def _resubmit(self, spec: TaskSpec) -> None:
         if spec.task_type == TaskType.ACTOR_TASK:
@@ -345,6 +374,11 @@ class Worker:
         blob = self.serde.serialize(
             err if isinstance(err, RayTpuError)
             else TaskError(err, spec.repr_name(), str(err))).to_bytes()
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            # No return refs: fail through task completion so the actor
+            # transitions to DEAD and its queued calls error out.
+            self._complete_task(spec.task_id, [], blob, None)
+            return
         for oid in spec.return_ids:
             self._store_result(oid, Entry("err", blob))
 
@@ -362,6 +396,64 @@ class Worker:
             self._on_actor_creation_done(spec, err_blob, system_error)
         self.task_manager.complete_task(task_id, results, err_blob,
                                         system_error)
+
+    # ------------------------------------------------------------------
+    # placement groups
+
+    def create_placement_group(self, pg_id, bundles, strategy, name):
+        info = self.pg_manager.create(pg_id, bundles, strategy, name)
+        self.node_group._wake.set()
+        return info
+
+    def remove_placement_group(self, pg_id) -> None:
+        created = False
+        info = self.pg_manager.get(pg_id)
+        if info is not None:
+            created = info.state == "CREATED"
+        self.pg_manager.remove(pg_id)
+        if not created:
+            oid = self._pg_ready_refs.get(pg_id)
+            if oid is not None and not self.memory_store.contains(oid):
+                from ray_tpu.exceptions import PlacementGroupError
+                self._store_error(oid, PlacementGroupError(
+                    f"placement group {pg_id.hex()[:12]} removed before "
+                    "it was scheduled"))
+        self.node_group._wake.set()
+
+    def pg_ready_ref(self, pg_id) -> ObjectRef:
+        with self._counter_lock:
+            oid = self._pg_ready_refs.get(pg_id)
+            if oid is None:
+                self._put_index += 1
+                oid = ObjectID.for_put(self.driver_task_id, self._put_index)
+                self._pg_ready_refs[pg_id] = oid
+                self.reference_counter.add_owned_object(oid)
+        info = self.pg_manager.get(pg_id)
+        if info is not None and info.state == "CREATED" \
+                and not self.memory_store.contains(oid):
+            self._store_pg_ready(pg_id, oid)
+        elif (info is None or info.state == "REMOVED") \
+                and not self.memory_store.contains(oid):
+            from ray_tpu.exceptions import PlacementGroupError
+            self._store_error(oid, PlacementGroupError(
+                f"placement group {pg_id.hex()[:12]} was removed"))
+        return ObjectRef(oid)
+
+    def _on_pg_created(self, info) -> None:
+        oid = self._pg_ready_refs.get(info.pg_id)
+        if oid is not None and not self.memory_store.contains(oid):
+            self._store_pg_ready(info.pg_id, oid)
+
+    def _store_pg_ready(self, pg_id, oid: ObjectID) -> None:
+        from ray_tpu.util.placement_group import PlacementGroup
+        info = self.pg_manager.get(pg_id)
+        handle = PlacementGroup(pg_id,
+                                [dict(b) for b in info.bundles])
+        self._put_value(oid, handle)
+
+    def _store_error(self, oid: ObjectID, err: BaseException) -> None:
+        blob = self.serde.serialize(err).to_bytes()
+        self._store_result(oid, Entry("err", blob))
 
     # ------------------------------------------------------------------
     # actors
@@ -387,9 +479,11 @@ class Worker:
             actor_creation_id=actor_id,
             max_restarts=options.max_restarts,
             max_task_retries=options.max_task_retries,
+            scheduling_strategy=options.scheduling_strategy,
             name=options.name or class_name,
             return_ids=[],
         )
+        self._apply_pg_strategy(spec, options)
         info = ActorInfo(
             actor_id=actor_id, name=options.name,
             namespace=options.namespace or "default",

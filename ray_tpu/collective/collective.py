@@ -1,0 +1,268 @@
+"""Actor-to-actor collective groups.
+
+Reference: ``python/ray/util/collective/`` [UNVERIFIED — mount empty,
+SURVEY.md §0] — collective groups over NCCL/Gloo between actors
+(allreduce / allgather / reducescatter / broadcast / send / recv /
+barrier).
+
+TPU-native redesign: *in-program* collectives are XLA ICI ops (see
+``ray_tpu.collective.xla``) and should carry the FLOP-heavy traffic.
+This module is the **host-side control-plane collective** between
+actor processes — the role Gloo plays in the reference: parameter
+averaging, barriers, small tensor exchange. Transport on one host is
+the shared-memory filesystem (``/dev/shm``) with atomic renames; the
+rendezvous layout (group dir / generation dir / per-rank files) is
+the same shape a DCN object-transfer backend plugs into for
+multi-host.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_BASE = os.environ.get("RAY_TPU_COLL_DIR", "/dev/shm/ray_tpu_coll")
+_POLL_S = 0.0005
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "prod"
+    MAX = "max"
+    MIN = "min"
+    MEAN = "mean"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
+    ReduceOp.PRODUCT: lambda xs: np.prod(xs, axis=0),
+    ReduceOp.MAX: lambda xs: np.max(xs, axis=0),
+    ReduceOp.MIN: lambda xs: np.min(xs, axis=0),
+    ReduceOp.MEAN: lambda xs: np.mean(xs, axis=0),
+}
+
+
+@dataclass
+class _Group:
+    name: str
+    rank: int
+    world_size: int
+    root: str
+    seq: int = 0
+    timeout_s: float = 60.0
+    _gc_pending: List[str] = field(default_factory=list)
+
+
+_groups: Dict[str, _Group] = {}
+
+
+def _atomic_save(path: str, arr: np.ndarray) -> None:
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.save(f, arr, allow_pickle=False)
+        os.rename(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def _wait_load(path: str, deadline: float) -> np.ndarray:
+    while True:
+        if os.path.exists(path):
+            try:
+                return np.load(path, allow_pickle=False)
+            except (ValueError, EOFError, OSError):
+                pass  # torn read before rename landed (shouldn't happen)
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"collective timed out waiting for {path}")
+        time.sleep(_POLL_S)
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "shm",
+                          group_name: str = "default",
+                          timeout_s: float = 60.0) -> None:
+    """Join a collective group. Every member must call this with the
+    same ``group_name`` and ``world_size`` and a distinct ``rank``."""
+    if backend not in ("shm", "gloo", "nccl"):
+        raise ValueError(f"unknown backend {backend!r}")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    root = os.path.join(_BASE, group_name)
+    os.makedirs(root, exist_ok=True)
+    g = _Group(group_name, rank, world_size, root, timeout_s=timeout_s)
+    _groups[group_name] = g
+    barrier(group_name)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    """Leave and tear down the group's rendezvous dir. Reusing a
+    ``group_name`` without destroying it first would read the previous
+    incarnation's generation files — ``create_collective_group``
+    generates unique names to avoid this entirely."""
+    g = _groups.pop(group_name, None)
+    if g is not None:
+        shutil.rmtree(g.root, ignore_errors=True)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _get(group_name).world_size
+
+
+def _get(group_name: str) -> _Group:
+    g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"no collective group {group_name!r} in this process; call "
+            "init_collective_group first")
+    return g
+
+
+def _gen_dir(g: _Group, tag: str) -> str:
+    g.seq += 1
+    d = os.path.join(g.root, f"{tag}_{g.seq:08d}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _finish(g: _Group, d: str) -> None:
+    """Mark this rank done with generation ``d``; lazily GC complete
+    generations at a safe distance (2 ops back)."""
+    open(os.path.join(d, f"done_{g.rank}"), "w").close()
+    g._gc_pending.append(d)
+    while len(g._gc_pending) > 2:
+        old = g._gc_pending[0]
+        if g.rank == 0:
+            if all(os.path.exists(os.path.join(old, f"done_{r}"))
+                   for r in range(g.world_size)):
+                shutil.rmtree(old, ignore_errors=True)
+                g._gc_pending.pop(0)
+            else:
+                break
+        else:
+            g._gc_pending.pop(0)
+
+
+def _as_np(tensor) -> np.ndarray:
+    return np.asarray(tensor)
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM) -> np.ndarray:
+    g = _get(group_name)
+    d = _gen_dir(g, "ar")
+    arr = _as_np(tensor)
+    _atomic_save(os.path.join(d, f"rank_{g.rank}.npy"), arr)
+    deadline = time.monotonic() + g.timeout_s
+    parts = [_wait_load(os.path.join(d, f"rank_{r}.npy"), deadline)
+             for r in range(g.world_size)]
+    out = _REDUCERS[op](np.stack(parts))
+    _finish(g, d)
+    return out
+
+
+def allgather(tensor, group_name: str = "default") -> List[np.ndarray]:
+    g = _get(group_name)
+    d = _gen_dir(g, "ag")
+    _atomic_save(os.path.join(d, f"rank_{g.rank}.npy"), _as_np(tensor))
+    deadline = time.monotonic() + g.timeout_s
+    parts = [_wait_load(os.path.join(d, f"rank_{r}.npy"), deadline)
+             for r in range(g.world_size)]
+    _finish(g, d)
+    return parts
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: str = ReduceOp.SUM) -> np.ndarray:
+    """Reduce across ranks, then scatter equal chunks along axis 0."""
+    g = _get(group_name)
+    arr = _as_np(tensor)
+    if arr.shape[0] % g.world_size != 0:
+        raise ValueError(
+            f"leading dim {arr.shape[0]} not divisible by world size "
+            f"{g.world_size}")
+    full = allreduce(arr, group_name, op)
+    chunk = full.shape[0] // g.world_size
+    return full[g.rank * chunk:(g.rank + 1) * chunk]
+
+
+def broadcast(tensor, src_rank: int = 0,
+              group_name: str = "default") -> np.ndarray:
+    g = _get(group_name)
+    d = _gen_dir(g, "bc")
+    deadline = time.monotonic() + g.timeout_s
+    path = os.path.join(d, f"rank_{src_rank}.npy")
+    if g.rank == src_rank:
+        _atomic_save(path, _as_np(tensor))
+        out = _as_np(tensor)
+    else:
+        out = _wait_load(path, deadline)
+    _finish(g, d)
+    return out
+
+
+def barrier(group_name: str = "default") -> None:
+    g = _get(group_name)
+    d = _gen_dir(g, "bar")
+    _atomic_save(os.path.join(d, f"rank_{g.rank}.npy"),
+                 np.zeros(1, np.int8))
+    deadline = time.monotonic() + g.timeout_s
+    for r in range(g.world_size):
+        _wait_load(os.path.join(d, f"rank_{r}.npy"), deadline)
+    _finish(g, d)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    """Point-to-point send. Pairs with a matching ``recv`` on dst."""
+    g = _get(group_name)
+    d = os.path.join(g.root, f"p2p_{g.rank}_to_{dst_rank}")
+    os.makedirs(d, exist_ok=True)
+    key = f"_p2p_send_{dst_rank}"
+    seq = getattr(g, key, 0)
+    _atomic_save(os.path.join(d, f"{seq:08d}.npy"), _as_np(tensor))
+    setattr(g, key, seq + 1)
+
+
+def recv(src_rank: int, group_name: str = "default") -> np.ndarray:
+    g = _get(group_name)
+    d = os.path.join(g.root, f"p2p_{src_rank}_to_{g.rank}")
+    os.makedirs(d, exist_ok=True)
+    key = f"_p2p_recv_{src_rank}"
+    seq = getattr(g, key, 0)
+    deadline = time.monotonic() + g.timeout_s
+    path = os.path.join(d, f"{seq:08d}.npy")
+    out = _wait_load(path, deadline)
+    try:
+        os.unlink(path)  # consumed: keep /dev/shm bounded
+    except OSError:
+        pass
+    setattr(g, key, seq + 1)
+    return out
+
+
+def create_collective_group(actors, world_size: int, ranks: List[int],
+                            backend: str = "shm",
+                            group_name: Optional[str] = None) -> str:
+    """Driver-side declaration: tell each actor to join the group.
+    Returns the group name (generated if not given)."""
+    import ray_tpu
+    if group_name is None:
+        group_name = f"group_{uuid.uuid4().hex[:8]}"
+    refs = [a._join_collective_group.remote(world_size, r, backend,
+                                            group_name)
+            for a, r in zip(actors, ranks)]
+    ray_tpu.get(refs, timeout=60)
+    return group_name

@@ -115,3 +115,8 @@ class PendingCallsLimitExceeded(RayTpuError):
 
 class ObjectStoreFullError(RayTpuError):
     pass
+
+
+class PlacementGroupError(RayTpuError):
+    """A task/actor bound to a placement group cannot run there
+    (group removed, or demand can never fit the bundle)."""
