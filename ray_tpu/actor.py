@@ -64,12 +64,12 @@ class ActorHandle:
         self._method_names = method_names
 
     def __getattr__(self, item: str):
+        if item in self._method_names:
+            return ActorMethod(self, item)
         if item.startswith("_"):
             raise AttributeError(item)
-        if item not in self._method_names:
-            raise AttributeError(
-                f"actor {self._class_name} has no method {item!r}")
-        return ActorMethod(self, item)
+        raise AttributeError(
+            f"actor {self._class_name} has no method {item!r}")
 
     def __repr__(self):
         return (f"ActorHandle({self._class_name}, "

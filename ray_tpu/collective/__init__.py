@@ -1,0 +1,26 @@
+"""Collectives: actor-level groups (host plane) + XLA collectives
+(device plane). See ``collective.py`` and ``xla.py``."""
+
+from ray_tpu.collective.collective import (
+    ReduceOp,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective import xla
+
+__all__ = [
+    "ReduceOp", "allgather", "allreduce", "barrier", "broadcast",
+    "create_collective_group", "destroy_collective_group",
+    "get_collective_group_size", "get_rank", "init_collective_group",
+    "recv", "reducescatter", "send", "xla",
+]

@@ -1,0 +1,109 @@
+"""In-program collectives: the TPU-native data plane.
+
+The reference moves tensors between GPUs with NCCL groups
+(``python/ray/util/collective/``) and aDAG NCCL channels
+[UNVERIFIED — mount empty, SURVEY.md §0]. On TPU those disappear:
+collectives are XLA ops compiled *into* the program and scheduled on
+ICI by the compiler (SURVEY.md §2.5, §5). These helpers are the named
+surface for that plane — thin, shard_map/pjit-friendly wrappers over
+``jax.lax`` collectives, plus a ``CollectiveGroup``-style facade so
+code written against the actor-collective API can be lowered into a
+jitted program by swapping the import.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AxisName = Union[str, Sequence[str]]
+
+
+def psum(x, axis: AxisName):
+    """All-reduce sum over a mesh axis (ICI collective; free at the
+    compiler's discretion to fuse with surrounding ops)."""
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: AxisName):
+    return lax.pmean(x, axis)
+
+
+def pmax(x, axis: AxisName):
+    return lax.pmax(x, axis)
+
+
+def pmin(x, axis: AxisName):
+    return lax.pmin(x, axis)
+
+
+def all_gather(x, axis: AxisName, *, gather_axis: int = 0,
+               tiled: bool = True):
+    """Gather shards along ``gather_axis`` from every device on the mesh
+    axis. ``tiled=True`` concatenates (the usual layout); ``False``
+    stacks a new leading device dimension."""
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0):
+    """Reduce-sum across the axis, leaving each device with its shard
+    along ``scatter_axis`` (rides ICI at half the cost of all-reduce
+    when the consumer only needs its shard)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                            tiled=True)
+
+
+def all_to_all(x, axis: AxisName, *, split_axis: int, concat_axis: int):
+    """Transpose data across the axis: split locally along
+    ``split_axis``, exchange, concatenate along ``concat_axis`` —
+    the Ulysses/MoE-dispatch primitive."""
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, axis: AxisName, perm: Sequence[tuple]):
+    """Point-to-point ring/permutation send — the ring-attention KV
+    rotation primitive. ``perm`` is [(src, dst), ...]."""
+    return lax.ppermute(x, axis, perm)
+
+
+def ring_shift(x, axis: AxisName, *, shift: int = 1,
+               axis_size: Optional[int] = None):
+    """Rotate shards around the mesh axis by ``shift`` (neighbour
+    exchange on the ICI torus)."""
+    n = axis_size if axis_size is not None else lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: AxisName):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: AxisName) -> int:
+    return lax.axis_size(axis)
+
+
+def barrier(axis: AxisName):
+    """Compiler-level synchronization point across the axis (an
+    all-reduce of a scalar; XLA will not reorder effects across it)."""
+    return lax.psum(jnp.zeros((), jnp.int32), axis)
+
+
+def shard_map_fn(mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
+    """Decorator: run a per-shard function over the mesh with explicit
+    collectives inside (``jax.shard_map`` with the house defaults)."""
+    def deco(fn):
+        smapped = jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs, check_vma=check_vma)
+        return functools.wraps(fn)(smapped)
+    return deco
+
+
+def device_put_sharded(x, mesh: Mesh, spec: P):
+    return jax.device_put(x, NamedSharding(mesh, spec))

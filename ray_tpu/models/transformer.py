@@ -236,9 +236,11 @@ def forward(params, tokens: jax.Array, cfg: TransformerConfig,
 def loss_fn(params, batch: Dict[str, jax.Array],
             cfg: TransformerConfig, attn_fn=None) -> jax.Array:
     """Next-token cross-entropy. batch: tokens [B,S]; optional
-    loss_mask [B,S]."""
+    loss_mask [B,S]. The forward runs on the full S (keeps the seq dim
+    divisible by the sp axis for ring attention); the shift to next-
+    token targets happens on the logits."""
     tokens = batch["tokens"]
-    logits = forward(params, tokens[:, :-1], cfg, attn_fn=attn_fn)
+    logits = forward(params, tokens, cfg, attn_fn=attn_fn)[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

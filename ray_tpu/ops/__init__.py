@@ -1,0 +1,16 @@
+"""TPU ops: fused attention kernels, sequence-parallel attention,
+expert-parallel MoE."""
+
+from ray_tpu.ops.flash_attention import flash_attention, mha_reference
+from ray_tpu.ops.moe import make_moe_fn, moe_mlp_shard
+from ray_tpu.ops.ring_attention import (
+    make_attention_fn,
+    ring_attention_shard,
+    ulysses_attention_shard,
+)
+
+__all__ = [
+    "flash_attention", "mha_reference", "make_attention_fn",
+    "make_moe_fn", "moe_mlp_shard",
+    "ring_attention_shard", "ulysses_attention_shard",
+]

@@ -1,0 +1,173 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The reference has no attention kernels — attention enters via torch in
+workloads hosted on it [SURVEY.md §2.5]. Here the fused blockwise
+kernel is first-class: the MXU does the two matmuls per block, online
+softmax keeps running (max, normalizer) so the S×S score matrix never
+materializes in HBM (HBM bandwidth is the bottleneck, not FLOPs).
+
+Forward is the Pallas kernel (grid over [batch×heads, query blocks],
+KV streamed through VMEM in blocks); backward recomputes attention via
+the reference formula under ``jax.vjp`` — exact gradients, no stored
+probabilities, trading recompute FLOPs for HBM exactly like
+``jax.checkpoint`` does.
+
+Layout everywhere: [B, S, N, H].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def mha_reference(q, k, v, *, causal: bool = True,
+                  sm_scale: Optional[float] = None,
+                  q_offset: int = 0, kv_offset: int = 0):
+    """Dense attention, [B,S,N,H]. Offsets shift absolute positions for
+    cross-shard causal masking (ring/ulysses callers)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqnh,bknh->bnqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        q_pos = q_offset + jnp.arange(s_q)
+        k_pos = kv_offset + jnp.arange(s_k)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bnqk,bknh->bqnh", probs.astype(v.dtype), v)
+
+
+# --------------------------------------------------------------------------
+# Pallas forward kernel
+# --------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
+                      sm_scale: float, block_k: int):
+    # q_ref: [block_q, H]; k_ref/v_ref: [S_k, H]; o_ref: [block_q, H]
+    block_q, head_dim = q_ref.shape
+    seq_k = k_ref.shape[0]
+    qi = pl.program_id(1)
+
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    n_kv = pl.cdiv(seq_k, block_k)
+
+    def body(j, carry):
+        o, m, l = carry
+        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [block_q, block_k]
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        pv = jax.lax.dot_general(
+            p, v_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        o_new = o * alpha[:, None] + pv
+        return o_new, m_new, l_new
+
+    o = jnp.zeros((block_q, head_dim), jnp.float32)
+    m = jnp.full((block_q,), -1e30, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    if causal:
+        # only blocks at or before the diagonal contribute
+        n_iter = jnp.minimum(n_kv, pl.cdiv((qi + 1) * block_q, block_k))
+    else:
+        n_iter = n_kv
+    o, m, l = jax.lax.fori_loop(0, n_iter, body, (o, m, l))
+    o_ref[:] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    b, s_q, n, h = q.shape
+    s_k = k.shape[1]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    # fold batch and heads into the grid; [BN, S, H] layout per head
+    qt = q.transpose(0, 2, 1, 3).reshape(b * n, s_q, h)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * n, s_k, h)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * n, s_k, h)
+    grid = (b * n, pl.cdiv(s_q, block_q))
+    kernel = functools.partial(_flash_fwd_kernel, causal=causal,
+                               sm_scale=sm_scale, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, h), lambda bn, i: (bn, i, 0)),
+            pl.BlockSpec((1, s_k, h), lambda bn, i: (bn, 0, 0)),
+            pl.BlockSpec((1, s_k, h), lambda bn, i: (bn, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, h), lambda bn, i: (bn, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * n, s_q, h), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, n, s_q, h).transpose(0, 2, 1, 3)
+
+
+# Pallas BlockSpec blocks carry the leading singleton; squeeze inside.
+def _squeeze_kernel(kernel):
+    @functools.wraps(kernel)
+    def wrapped(q_ref, k_ref, v_ref, o_ref, **kw):
+        return kernel(q_ref.at[0], k_ref.at[0], v_ref.at[0], o_ref.at[0],
+                      **kw)
+    return wrapped
+
+
+_flash_fwd_kernel = _squeeze_kernel(_flash_fwd_kernel)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention. [B,S,N,H] -> [B,S,N,H]."""
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                      interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret,
+                   residuals, g):
+    q, k, v = residuals
+    # Recompute-based exact gradient (flash-style backward is a later
+    # optimization; this keeps HBM use flat at the cost of FLOPs).
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal,
+                                         sm_scale=sm_scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

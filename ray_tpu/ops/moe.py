@@ -1,0 +1,108 @@
+"""Mixture-of-Experts with expert parallelism over an ICI axis.
+
+ABSENT from the reference (delegated to hosted frameworks,
+SURVEY.md §2.5 "Expert parallel"). TPU-native design: capacity-based
+top-k routing, dense dispatch/combine einsums (MXU-friendly one-hots,
+no gather/scatter), and a pair of ``all_to_all`` exchanges over the
+expert axis — send each token to the device that owns its expert,
+bring the FFN output back. Built as a per-shard function for
+``jax.shard_map``; the expert weight tables shard their leading E dim
+over the same axis.
+
+Shapes (per shard): tokens [T, D]; wi/wg [E_local, D, F];
+wo [E_local, F, D]; router [D, E_global].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+AxisName = Union[str, Sequence[str]]
+
+
+def _top_k_routing(h, router_w, n_experts: int, top_k: int,
+                   capacity: int):
+    """Returns dispatch [T,E,C] one-hot and combine [T,E,C] weights."""
+    logits = h.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                  # [T,E]
+    top_w, top_i = lax.top_k(probs, top_k)                   # [T,k]
+    top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+    # expert assignment mask per routing slot: [k,T,E]
+    slot_onehot = jax.nn.one_hot(top_i.T, n_experts, dtype=jnp.float32)
+    # position of each token within its expert's queue, counted over
+    # slots-major order (slot 0 of all tokens first, then slot 1, ...)
+    flat = slot_onehot.reshape(-1, n_experts)                # [k*T,E]
+    pos = jnp.cumsum(flat, axis=0) - flat                    # [k*T,E]
+    pos = pos.reshape(top_k, -1, n_experts)                  # [k,T,E]
+    keep = (pos < capacity) * slot_onehot                    # [k,T,E]
+    pos_onehot = jax.nn.one_hot(
+        jnp.sum(pos * slot_onehot, axis=-1).astype(jnp.int32), capacity,
+        dtype=jnp.float32)                                   # [k,T,C]
+    # dispatch[t,e,c] = 1 iff token t occupies slot c of expert e
+    dispatch = jnp.einsum("kte,ktc->tec", keep, pos_onehot)
+    combine = jnp.einsum("kte,kt,ktc->tec", keep, top_w.T, pos_onehot)
+    return dispatch, combine
+
+
+def moe_mlp_shard(h, router_w, wi, wg, wo, *,
+                  axis_name: Optional[AxisName] = "ep",
+                  n_experts: int, top_k: int = 2,
+                  capacity_factor: float = 2.0):
+    """Per-shard expert-parallel SwiGLU MoE (call inside shard_map).
+
+    With ``axis_name=None`` runs single-shard (all experts local) —
+    the same code path, minus the exchanges.
+    """
+    t, d = h.shape
+    ep = lax.axis_size(axis_name) if axis_name is not None else 1
+    e_local = wi.shape[0]
+    assert e_local * ep == n_experts, (e_local, ep, n_experts)
+    capacity = max(1, int(np.ceil(t * top_k / n_experts
+                                  * capacity_factor)))
+    dispatch, combine = _top_k_routing(h, router_w, n_experts, top_k,
+                                       capacity)
+    dt = h.dtype
+    x = jnp.einsum("tec,td->ecd", dispatch.astype(dt), h)     # [E,C,D]
+    if ep > 1:
+        # -> [E_local, ep*C, D]: tokens from every shard for my experts
+        x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1,
+                           tiled=True)
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", x, wg.astype(dt)))
+    up = jnp.einsum("ecd,edf->ecf", x, wi.astype(dt))
+    out = jnp.einsum("ecf,efd->ecd", gate * up, wo.astype(dt))
+    if ep > 1:
+        out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
+                             tiled=True)                      # [E,C,D]
+    return jnp.einsum("tec,ecd->td", combine.astype(dt), out)
+
+
+def make_moe_fn(mesh: Mesh, *, n_experts: int, top_k: int = 2,
+                capacity_factor: float = 2.0,
+                token_axes: AxisName = ("dp", "fsdp", "sp"),
+                ep_axis: Optional[str] = None):
+    """Build a global-arrays MoE fn over the mesh.
+
+    Tokens shard over ``token_axes``; expert tables shard E over the
+    same devices (standard TPU MoE: ep reuses the data axes rather
+    than a dedicated mesh dimension, SURVEY.md §2.5 / mesh.py). Pass
+    ``ep_axis`` to use a dedicated axis instead.
+    """
+    axis = ep_axis if ep_axis is not None else token_axes
+    ep = int(np.prod([mesh.shape[a] for a in
+                      ((axis,) if isinstance(axis, str) else axis)]))
+    body = functools.partial(
+        moe_mlp_shard, axis_name=axis, n_experts=n_experts,
+        top_k=top_k, capacity_factor=capacity_factor)
+    tok_spec = P(token_axes, None)
+    ew_spec = P(token_axes if ep_axis is None else ep_axis, None, None)
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(tok_spec, P(None, None), ew_spec, ew_spec, ew_spec),
+        out_specs=tok_spec, check_vma=False), ep

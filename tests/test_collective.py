@@ -1,0 +1,120 @@
+"""Actor-level collective groups (reference: ray.util.collective tests)
+and the XLA device-plane helpers on a fake 8-device mesh."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import collective as col
+
+
+@ray_tpu.remote
+class Member:
+    def _join_collective_group(self, world, rank, backend, name):
+        col.init_collective_group(world, rank, backend, name,
+                                  timeout_s=30.0)
+        self._group = name
+        return rank
+
+    def do_allreduce(self, value):
+        return col.allreduce(np.asarray(value, np.float32), self._group)
+
+    def do_allgather(self, value):
+        return col.allgather(np.asarray(value, np.float32), self._group)
+
+    def do_reducescatter(self, value):
+        return col.reducescatter(np.asarray(value, np.float32), self._group)
+
+    def do_broadcast(self, value, src):
+        return col.broadcast(np.asarray(value, np.float32), src,
+                             self._group)
+
+    def do_sendrecv(self, value, peer, is_sender):
+        if is_sender:
+            col.send(np.asarray(value, np.float32), peer, self._group)
+            return None
+        return col.recv(peer, self._group)
+
+    def leave(self):
+        col.destroy_collective_group(self._group)
+        return True
+
+
+@pytest.fixture
+def members(ray_start_regular):
+    ms = [Member.options(num_cpus=0.5).remote() for _ in range(2)]
+    name = col.create_collective_group(ms, world_size=2, ranks=[0, 1])
+    yield ms
+    ray_tpu.get([m.leave.remote() for m in ms], timeout=30)
+
+
+def test_allreduce_and_allgather(members):
+    outs = ray_tpu.get(
+        [m.do_allreduce.remote([float(i + 1)] * 3)
+         for i, m in enumerate(members)], timeout=60)
+    for o in outs:
+        np.testing.assert_allclose(o, [3.0, 3.0, 3.0])
+    gathers = ray_tpu.get(
+        [m.do_allgather.remote([float(i)]) for i, m in enumerate(members)],
+        timeout=60)
+    for g in gathers:
+        np.testing.assert_allclose(np.concatenate(g), [0.0, 1.0])
+
+
+def test_reducescatter_broadcast_sendrecv(members):
+    outs = ray_tpu.get(
+        [m.do_reducescatter.remote([1.0, 2.0, 3.0, 4.0])
+         for m in members], timeout=60)
+    np.testing.assert_allclose(outs[0], [2.0, 4.0])
+    np.testing.assert_allclose(outs[1], [6.0, 8.0])
+
+    outs = ray_tpu.get(
+        [m.do_broadcast.remote([float(i) * 7], 1)
+         for i, m in enumerate(members)], timeout=60)
+    for o in outs:
+        np.testing.assert_allclose(o, [7.0])
+
+    r_send = members[0].do_sendrecv.remote([5.0, 6.0], 1, True)
+    r_recv = members[1].do_sendrecv.remote(None, 0, False)
+    ray_tpu.get(r_send, timeout=60)
+    np.testing.assert_allclose(ray_tpu.get(r_recv, timeout=60), [5.0, 6.0])
+
+
+def test_xla_collectives_on_mesh():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.collective import xla
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    devs = jax.devices()
+    assert len(devs) >= 8
+    spec = MeshSpec.auto(8, tp=1, sp=1)
+    mesh = make_mesh(spec, devs[:8])
+    axes = [n for n, s in mesh.shape.items() if s > 1]
+    axis = axes[0]
+
+    x = jnp.arange(16.0).reshape(8, 2)
+
+    @xla.shard_map_fn(mesh, in_specs=P(axis), out_specs=P(axis))
+    def f(shard):
+        total = xla.psum(jnp.sum(shard), axis)
+        rot = xla.ring_shift(shard, axis, shift=1)
+        return shard + 0 * total + 0 * rot
+
+    np.testing.assert_allclose(np.asarray(f(x)), np.asarray(x))
+
+    @xla.shard_map_fn(mesh, in_specs=P(axis), out_specs=P())
+    def total_sum(shard):
+        return xla.psum(jnp.sum(shard), axis)
+
+    assert float(total_sum(x)) == float(np.sum(np.arange(16.0)))
+
+    @xla.shard_map_fn(mesh, in_specs=P(axis), out_specs=P(axis))
+    def rs(shard):
+        # all_gather then reduce_scatter along the same axis is identity
+        g = xla.all_gather(shard, axis, gather_axis=0)
+        return xla.reduce_scatter(g, axis, scatter_axis=0) / 8.0
+
+    np.testing.assert_allclose(np.asarray(rs(x)), np.asarray(x))

@@ -1,0 +1,89 @@
+"""Flagship transformer: sharded train step, ring-attention parity,
+MoE path, and the driver entry hooks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import (
+    TransformerConfig,
+    forward,
+    init_params,
+    init_state,
+    loss_fn,
+    make_optimizer,
+    make_train_step,
+)
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+
+
+def _cfg(**kw):
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("d_model", 64)
+    kw.setdefault("n_layers", 2)
+    kw.setdefault("n_heads", 4)
+    kw.setdefault("n_kv_heads", 2)
+    kw.setdefault("d_ff", 128)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("dtype", jnp.float32)
+    return TransformerConfig(**kw)
+
+
+def _tokens(b=4, s=64, vocab=128, seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).randint(0, vocab, (b, s)), jnp.int32)
+
+
+def test_sp_mesh_loss_matches_single_device():
+    cfg = _cfg()
+    tokens = _tokens()
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    dense = float(loss_fn(params, {"tokens": tokens}, cfg))
+
+    mesh = make_mesh(MeshSpec.auto(8, sp=4), jax.devices()[:8])
+    from ray_tpu.ops import make_attention_fn
+    attn = make_attention_fn(mesh, impl="ring")
+    sharding = NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    toks = jax.device_put(tokens, sharding)
+    with mesh:
+        ring = float(jax.jit(
+            lambda p, b: loss_fn(p, b, cfg, attn))(params,
+                                                   {"tokens": toks}))
+    np.testing.assert_allclose(ring, dense, rtol=1e-4)
+
+
+def test_train_step_learns_on_sp_mesh():
+    cfg = _cfg()
+    mesh = make_mesh(MeshSpec.auto(8, tp=2, sp=2), jax.devices()[:8])
+    tx = make_optimizer(lr=1e-2, total_steps=50)
+    with mesh:
+        state = init_state(jax.random.PRNGKey(0), cfg, tx, mesh)
+        step = make_train_step(cfg, tx, mesh)
+        sharding = NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+        tokens = jax.device_put(_tokens(), sharding)
+        losses = []
+        for _ in range(8):
+            state, metrics = step(state, {"tokens": tokens})
+            losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_moe_forward_and_grads():
+    cfg = _cfg(use_moe=True, n_experts=4, expert_top_k=2)
+    tokens = _tokens(b=2, s=32)
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    loss, grads = jax.value_and_grad(loss_fn)(params, {"tokens": tokens},
+                                              cfg)
+    assert np.isfinite(float(loss))
+    flat = jax.tree.leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+
+
+def test_graft_entry_hooks():
+    import __graft_entry__ as ge
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == 2
+    ge.dryrun_multichip(8)
