@@ -1,0 +1,21 @@
+"""ray_tpu.data: lazy, streaming Dataset over the core task API."""
+
+from ray_tpu.data.dataset import (
+    DataIterator,
+    Dataset,
+    GroupedData,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+)
+
+__all__ = [
+    "DataIterator", "Dataset", "GroupedData", "from_arrow", "from_items",
+    "from_numpy", "from_pandas", "range", "read_csv", "read_json",
+    "read_parquet",
+]

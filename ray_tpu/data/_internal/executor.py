@@ -1,0 +1,502 @@
+"""Streaming executor: pull-based pipelined execution of a physical
+plan over the core task/actor API.
+
+Reference: ``python/ray/data/_internal/execution/streaming_executor.py``
++ ``operators/{task_pool_map_operator,actor_pool_map_operator}.py`` +
+``backpressure_policy/`` [UNVERIFIED — mount empty, SURVEY.md §0].
+
+Key properties preserved:
+- blocks stream between stages with NO barrier between map stages —
+  block k can be in stage 3 while block k+1 is in stage 1;
+- per-stage in-flight caps (concurrency backpressure) bound memory;
+- all-to-all stages (repartition/shuffle/sort/groupby) are the only
+  barriers, implemented as two-phase split/reduce task fan-out through
+  the object store (num_returns=N split tasks, one reduce per
+  partition);
+- everything is tasks/actors on the public core API — the
+  libraries-on-core invariant (SURVEY.md §1).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data._internal.plan import (
+    AllToAllStage,
+    LimitStage,
+    MapStage,
+    MapTransform,
+    PhysicalPlan,
+)
+from ray_tpu.data import block as blib
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------
+# Remote kernels (plain functions on the core API)
+# --------------------------------------------------------------------------
+
+def _apply_transform(t: MapTransform, blk):
+    if t.kind == "batches":
+        out_parts = []
+        n = blk.num_rows
+        bs = t.batch_size or n or 1
+        for start in range(0, max(n, 1), bs):
+            piece = blib.slice_block(blk, start, min(start + bs, n)) \
+                if n else blk
+            batch = blib.block_to_batch(piece, t.batch_format)
+            res = t.fn(batch, *t.fn_args, **t.fn_kwargs)
+            out_parts.append(blib.block_from_batch(res))
+            if n == 0:
+                break
+        return blib.concat_blocks(out_parts)
+    rows_out: List[Any] = []
+    for row in blib.batch_to_rows(blk):
+        if t.kind == "rows":
+            rows_out.append(t.fn(row, *t.fn_args, **t.fn_kwargs))
+        elif t.kind == "filter":
+            if t.fn(row, *t.fn_args, **t.fn_kwargs):
+                rows_out.append(row)
+        elif t.kind == "flat":
+            rows_out.extend(t.fn(row, *t.fn_args, **t.fn_kwargs))
+        else:
+            raise ValueError(t.kind)
+    return blib.block_from_rows(rows_out)
+
+
+@ray_tpu.remote
+def _map_chain_task(transforms: List[MapTransform], blk):
+    for t in transforms:
+        blk = _apply_transform(t, blk)
+    return blk
+
+
+@ray_tpu.remote
+def _read_task(fn):
+    return blib.block_from_batch(fn())
+
+
+@ray_tpu.remote
+class _MapWorker:
+    """Actor-pool worker: instantiates the user's callable class once,
+    reuses it per block (reference: ActorPoolMapOperator)."""
+
+    def __init__(self, transforms: List[MapTransform]):
+        self._transforms = []
+        for t in transforms:
+            fn = t.fn
+            import inspect
+            if inspect.isclass(fn):
+                fn = fn(*t.fn_args, **t.fn_kwargs)
+                t = MapTransform(t.kind, fn, (), {}, t.batch_size,
+                                 t.batch_format)
+            self._transforms.append(t)
+
+    def apply(self, blk):
+        for t in self._transforms:
+            blk = _apply_transform(t, blk)
+        return blk
+
+
+# -- all-to-all kernels ----------------------------------------------------
+
+def _split_fn_factory(kind: str, n: int, kwargs: Dict):
+    key = kwargs.get("key")
+    boundaries = kwargs.get("boundaries")
+    seed = kwargs.get("seed")
+
+    def split(blk):
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        rows = blk.num_rows
+        if rows == 0:
+            return [blk] * n if n > 1 else blk
+        if kind == "repartition":
+            idx = np.arange(rows) * n // max(rows, 1)
+        elif kind == "shuffle":
+            rng = np.random.RandomState(seed)
+            idx = rng.randint(0, n, rows)
+        elif kind == "sort":
+            col = blk.column(key).to_numpy(zero_copy_only=False)
+            idx = np.searchsorted(boundaries, col, side="right")
+        elif kind == "groupby":
+            col = blk.column(key).to_numpy(zero_copy_only=False)
+            idx = np.asarray([hash(x) % n for x in col.tolist()])
+        else:
+            raise ValueError(kind)
+        order = np.argsort(idx, kind="stable")
+        sorted_blk = blk.take(pa.array(order))
+        counts = np.bincount(idx, minlength=n)
+        parts, start = [], 0
+        for c in counts:
+            parts.append(sorted_blk.slice(start, int(c)))
+            start += int(c)
+        return parts if n > 1 else parts[0]
+
+    return split
+
+
+def _reduce_fn_factory(kind: str, kwargs: Dict):
+    key = kwargs.get("key")
+    descending = kwargs.get("descending", False)
+    aggs = kwargs.get("aggs")
+    seed = kwargs.get("seed")
+
+    def reduce(*parts):
+        import pyarrow as pa
+        blk = blib.concat_blocks(list(parts))
+        if kind == "sort":
+            if blk.num_rows:
+                blk = blk.sort_by([(key, "descending" if descending
+                                    else "ascending")])
+        elif kind == "shuffle":
+            if blk.num_rows:
+                rng = np.random.RandomState(seed)
+                blk = blk.take(pa.array(rng.permutation(blk.num_rows)))
+        elif kind == "groupby":
+            blk = _aggregate_block(blk, key, aggs)
+        return blk
+
+    return reduce
+
+
+def _aggregate_block(blk, key: str, aggs: List):
+    """aggs: [(col, op, out_name)] with op in count/sum/mean/min/max."""
+    import pyarrow as pa
+    if blk.num_rows == 0:
+        return blk
+    arrow_aggs = []
+    for col, op, _out in aggs:
+        arrow_aggs.append((col if col else key,
+                           {"count": "count", "sum": "sum", "mean": "mean",
+                            "min": "min", "max": "max"}[op]))
+    return pa.TableGroupBy(blk, key).aggregate(arrow_aggs)
+
+
+@ray_tpu.remote
+def _sample_task(blk, key: str, k: int):
+    rows = blk.num_rows
+    if rows == 0:
+        return np.asarray([])
+    col = blk.column(key).to_numpy(zero_copy_only=False)
+    rng = np.random.RandomState(0)
+    return col[rng.randint(0, rows, min(k, rows))]
+
+
+# --------------------------------------------------------------------------
+# Streaming loop
+# --------------------------------------------------------------------------
+
+class _MapRuntime:
+    def __init__(self, stage: MapStage, max_in_flight: int):
+        self.stage = stage
+        self.inputs: deque = deque()
+        self.in_flight: Dict[Any, int] = {}       # ref -> seq
+        self.ready: Dict[int, Any] = {}           # seq -> ref (completed)
+        self.next_in_seq = 0
+        self.next_out_seq = 0
+        self.input_done = False
+        self.max_in_flight = max_in_flight
+        self.actors: List = []
+        self.actor_busy: Dict[int, int] = {}      # actor idx -> in-flight
+        self._ref_actor: Dict[Any, int] = {}
+
+    def ensure_actors(self):
+        if self.stage.uses_actors and not self.actors:
+            n = self.stage.concurrency or 2
+            opts = dict(self.stage.resources)
+            kw = {}
+            if "CPU" in opts:
+                kw["num_cpus"] = opts["CPU"]
+            if "TPU" in opts:
+                kw["num_tpus"] = opts["TPU"]
+            self.actors = [
+                _MapWorker.options(**kw).remote(self.stage.transforms)
+                for _ in range(n)]
+            self.actor_busy = {i: 0 for i in range(len(self.actors))}
+
+    def launch(self):
+        self.ensure_actors()
+        while self.inputs and len(self.in_flight) < self.max_in_flight:
+            blk_ref, seq = self.inputs.popleft()
+            if self.stage.uses_actors:
+                idx = min(self.actor_busy, key=self.actor_busy.get)
+                ref = self.actors[idx].apply.remote(blk_ref)
+                self.actor_busy[idx] += 1
+                self._ref_actor[ref] = idx
+            else:
+                kw = {}
+                res = self.stage.resources
+                if "CPU" in res:
+                    kw["num_cpus"] = res["CPU"]
+                if "TPU" in res:
+                    kw["num_tpus"] = res["TPU"]
+                ref = _map_chain_task.options(**kw).remote(
+                    self.stage.transforms, blk_ref)
+            self.in_flight[ref] = seq
+
+    def complete(self, ref):
+        seq = self.in_flight.pop(ref)
+        idx = self._ref_actor.pop(ref, None)
+        if idx is not None:
+            self.actor_busy[idx] -= 1
+        self.ready[seq] = ref
+
+    def pop_ready_in_order(self):
+        out = []
+        while self.next_out_seq in self.ready:
+            out.append(self.ready.pop(self.next_out_seq))
+            self.next_out_seq += 1
+        return out
+
+    @property
+    def done(self):
+        return (self.input_done and not self.inputs
+                and not self.in_flight and not self.ready)
+
+    def shutdown(self):
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self.actors = []
+
+
+class StreamingExecutor:
+    """Drives a PhysicalPlan; iterate over output block refs."""
+
+    def __init__(self, plan: PhysicalPlan, *, max_in_flight: int = 8,
+                 name: str = "dataset"):
+        self._plan = plan
+        self._max_in_flight = max_in_flight
+        self._name = name
+
+    def output_refs(self) -> Iterator[Any]:
+        plan = self._plan
+        # Materialize source refs for this run: launch read tasks
+        # incrementally; extra (union) sources are chained after.
+        source: deque = deque()
+        pending_reads: deque = deque(plan.read_tasks)
+        source.extend(plan.source_refs)
+        for extra in plan.extra_sources:
+            if extra.stages:
+                for ref in StreamingExecutor(
+                        extra, max_in_flight=self._max_in_flight).run():
+                    source.append(ref)
+            else:
+                source.extend(extra.source_refs)
+                pending_reads.extend(extra.read_tasks)
+
+        # barrier-free by construction (run() segments at barriers)
+        yield from self._run_segment(source, pending_reads, plan.stages)
+
+    # -- segment runner ----------------------------------------------------
+
+    def _run_segment(self, source: deque, pending_reads: deque,
+                     stages: List) -> Iterator[Any]:
+        assert not any(isinstance(st, AllToAllStage) for st in stages), \
+            "barriers are segmented out by run()"
+        map_stages = stages
+
+        runtimes: List[_MapRuntime] = []
+        limit_remaining: Dict[int, int] = {}
+        pipeline: List = []
+        for st in map_stages:
+            if isinstance(st, MapStage):
+                rt = _MapRuntime(st, self._max_in_flight)
+                runtimes.append(rt)
+                pipeline.append(rt)
+            elif isinstance(st, LimitStage):
+                limit_remaining[id(st)] = st.n
+                pipeline.append(st)
+
+        read_in_flight: Dict[Any, int] = {}
+        read_seq = 0
+        emitted: List[Any] = []
+        stop = False
+
+        def feed_first(ref):
+            nonlocal stop
+            ref = self._through_limits(ref, pipeline, 0, limit_remaining)
+            if ref is None:
+                stop = True   # a limit is exhausted: stop feeding reads
+                return
+            tgt = next((it for it in pipeline
+                        if isinstance(it, _MapRuntime)), None)
+            if tgt is not None:
+                tgt.inputs.append((ref, tgt.next_in_seq))
+                tgt.next_in_seq += 1
+            else:
+                emitted.append(ref)
+
+        # ---- streaming loop ----
+        out_queue: deque = deque()
+        try:
+            while True:
+                # 1. launch reads
+                while (pending_reads
+                       and len(read_in_flight) < self._max_in_flight
+                       and not stop):
+                    fn = pending_reads.popleft()
+                    read_in_flight[_read_task.remote(fn)] = read_seq
+                    read_seq += 1
+                while source:
+                    feed_first(source.popleft())
+                # 2. launch map work
+                for rt in runtimes:
+                    rt.launch()
+                # 3. wait for anything
+                all_refs = (list(read_in_flight)
+                            + [r for rt in runtimes for r in rt.in_flight])
+                if not all_refs:
+                    while emitted:
+                        yield emitted.pop(0)
+                    if (stop or not pending_reads) and all(
+                            rt.done for rt in runtimes):
+                        break
+                    continue
+                ready, _ = ray_tpu.wait(
+                    all_refs, num_returns=1, timeout=0.5)
+                # 4. route completions
+                for ref in ready:
+                    if ref in read_in_flight:
+                        read_in_flight.pop(ref)
+                        feed_first(ref)
+                        continue
+                    for i, rt in enumerate(runtimes):
+                        if ref in rt.in_flight:
+                            rt.complete(ref)
+                            break
+                # 5. move ordered outputs downstream
+                for i, item in enumerate(pipeline):
+                    if not isinstance(item, _MapRuntime):
+                        continue
+                    for ref in item.pop_ready_in_order():
+                        ref_out = self._through_limits(
+                            ref, pipeline, i + 1, limit_remaining)
+                        if ref_out is None:
+                            continue
+                        tgt = None
+                        for j in range(i + 1, len(pipeline)):
+                            if isinstance(pipeline[j], _MapRuntime):
+                                tgt = pipeline[j]
+                                break
+                        if tgt is not None:
+                            tgt.inputs.append(
+                                (ref_out, tgt.next_in_seq))
+                            tgt.next_in_seq += 1
+                        else:
+                            emitted.append(ref_out)
+                # mark input done for chained stages
+                first_done = ((stop or not pending_reads)
+                              and not read_in_flight and not source)
+                prev_done = first_done
+                for item in pipeline:
+                    if isinstance(item, _MapRuntime):
+                        item.input_done = prev_done
+                        prev_done = item.done
+                # 6. emit
+                while emitted:
+                    out_queue.append(emitted.pop(0))
+                while out_queue:
+                    yield out_queue.popleft()
+        finally:
+            for rt in runtimes:
+                rt.shutdown()
+
+    def _through_limits(self, ref, pipeline, start_idx, limit_remaining):
+        """Apply any LimitStage between start_idx-1 and the next map."""
+        for j in range(start_idx, len(pipeline)):
+            item = pipeline[j]
+            if isinstance(item, _MapRuntime):
+                break
+            if isinstance(item, LimitStage):
+                rem = limit_remaining[id(item)]
+                if rem <= 0:
+                    return None
+                blk = ray_tpu.get(ref)
+                if blk.num_rows > rem:
+                    blk = blib.slice_block(blk, 0, rem)
+                    ref = ray_tpu.put(blk)
+                limit_remaining[id(item)] = rem - blk.num_rows
+        return ref
+
+    # -- full run with barriers -------------------------------------------
+
+    def run(self) -> Iterator[Any]:
+        """Yield final output block refs, handling barrier stages by
+        segmenting the plan."""
+        plan = self._plan
+        stages = list(plan.stages)
+        segment_source = deque(plan.source_refs)
+        pending_reads = deque(plan.read_tasks)
+        extra = plan.extra_sources
+
+        while True:
+            barrier_idx = None
+            for i, st in enumerate(stages):
+                if isinstance(st, AllToAllStage):
+                    barrier_idx = i
+                    break
+            seg_stages = stages if barrier_idx is None \
+                else stages[:barrier_idx]
+            seg_plan = PhysicalPlan(
+                source_refs=list(segment_source),
+                read_tasks=list(pending_reads),
+                stages=seg_stages, extra_sources=extra)
+            extra = []
+            seg_exec = StreamingExecutor(seg_plan,
+                                         max_in_flight=self._max_in_flight)
+            if barrier_idx is None:
+                yield from seg_exec.output_refs()
+                return
+            # barrier: drain segment, run the all-to-all, continue
+            upstream_refs = list(seg_exec.output_refs())
+            barrier = stages[barrier_idx]
+            segment_source = deque(
+                self._run_all_to_all(barrier, upstream_refs))
+            pending_reads = deque()
+            stages = stages[barrier_idx + 1:]
+
+    def _run_all_to_all(self, stage: AllToAllStage, refs: List) -> List:
+        kind = stage.kind
+        kwargs = dict(stage.kwargs)
+        n_out = kwargs.get("num_partitions") or max(len(refs), 1)
+        if not refs:
+            return []
+        if kind == "sort":
+            # sample boundaries
+            key = kwargs["key"]
+            samples = ray_tpu.get(
+                [_sample_task.remote(r, key, 32) for r in refs])
+            allv = np.concatenate([s for s in samples if len(s)]) \
+                if any(len(s) for s in samples) else np.asarray([0])
+            qs = np.linspace(0, 100, n_out + 1)[1:-1]
+            kwargs["boundaries"] = np.percentile(allv, qs) if len(allv) \
+                else np.asarray([])
+            if kwargs.get("descending"):
+                pass  # partitions sorted ascending then reversed at concat
+        split = _split_fn_factory(kind, n_out, kwargs)
+        reduce = _reduce_fn_factory(kind, kwargs)
+        split_remote = ray_tpu.remote(split)
+        parts: List[List] = []
+        for r in refs:
+            out = split_remote.options(num_returns=n_out).remote(r)
+            if n_out == 1:
+                out = [out]
+            parts.append(out)
+        reduce_remote = ray_tpu.remote(reduce)
+        out_refs = []
+        for i in range(n_out):
+            out_refs.append(
+                reduce_remote.remote(*[p[i] for p in parts]))
+        if kind == "sort" and kwargs.get("descending"):
+            out_refs = list(reversed(out_refs))
+        return out_refs
