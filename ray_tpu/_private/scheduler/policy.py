@@ -294,6 +294,15 @@ class CompositeSchedulingPolicy(ISchedulingPolicy):
         return results
 
 
+def _cpu_hybrid_policy() -> ISchedulingPolicy:
+    """Native C++ hybrid when the library builds, else pure Python."""
+    try:
+        from ray_tpu._private.scheduler import native_policy  # noqa: F401
+        return create_policy("hybrid_native")
+    except ImportError:
+        return create_policy("hybrid")
+
+
 def default_policy() -> ISchedulingPolicy:
     cfg = get_config()
     inner: ISchedulingPolicy
@@ -306,7 +315,7 @@ def default_policy() -> ISchedulingPolicy:
             logging.getLogger(__name__).warning(
                 "use_tpu_scheduler=1 but the TPU policy is unavailable "
                 "(%s); falling back to hybrid", e)
-            inner = create_policy("hybrid")
+            inner = _cpu_hybrid_policy()
     else:
-        inner = create_policy("hybrid")
+        inner = _cpu_hybrid_policy()
     return CompositeSchedulingPolicy(inner)

@@ -1,0 +1,122 @@
+"""Native C++ scheduling policy: parity with the Python hybrid policy
+(reference: cluster_resource_scheduler_test.cc semantics)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.scheduler.policy import (
+    HybridSchedulingPolicy,
+    SchedulingRequest,
+)
+from ray_tpu._private.scheduler.resources import (
+    ClusterResourceManager,
+    NodeResources,
+)
+
+
+def _cluster(n=6, cpus=8.0):
+    c = ClusterResourceManager()
+    ids = []
+    for i in range(n):
+        nid = NodeID.from_random()
+        ids.append(nid)
+        c.add_or_update_node(nid, NodeResources.of(CPU=cpus, memory=64))
+    return c, ids
+
+
+def _native():
+    pytest.importorskip("ctypes")
+    from ray_tpu._private.scheduler.native_policy import (
+        NativeHybridSchedulingPolicy)
+    return NativeHybridSchedulingPolicy()
+
+
+def test_native_builds_and_schedules():
+    pol = _native()
+    cluster, ids = _cluster()
+    reqs = [SchedulingRequest(demand={"CPU": 1.0}) for _ in range(20)]
+    results = pol.schedule_batch(cluster, reqs)
+    assert all(r.node_id is not None for r in results)
+    # batch packs without oversubscription: 6 nodes x 8 cpus >= 20
+    from collections import Counter
+    counts = Counter(r.node_id for r in results)
+    assert all(v <= 8 for v in counts.values())
+
+
+def test_native_prefers_local_until_threshold():
+    pol = _native()
+    cluster, ids = _cluster(n=3, cpus=10.0)
+    pref = ids[0]
+    reqs = [SchedulingRequest(demand={"CPU": 1.0}, preferred_node=pref)
+            for _ in range(10)]
+    results = pol.schedule_batch(cluster, reqs)
+    # threshold 0.5 -> first 5 land on the preferred node
+    assert [r.node_id for r in results[:5]] == [pref] * 5
+    assert all(r.node_id != pref for r in results[5:8])
+
+
+def test_native_infeasible_vs_busy():
+    pol = _native()
+    cluster, ids = _cluster(n=2, cpus=2.0)
+    res = pol.schedule_batch(cluster, [
+        SchedulingRequest(demand={"CPU": 100.0})])[0]
+    assert res.node_id is None and res.is_infeasible
+    res = pol.schedule_batch(cluster, [
+        SchedulingRequest(demand={"GPU": 1.0})])[0]
+    assert res.node_id is None and res.is_infeasible
+    # consume everything, then a request is busy (not infeasible)
+    busy = pol.schedule_batch(cluster, [
+        SchedulingRequest(demand={"CPU": 2.0}),
+        SchedulingRequest(demand={"CPU": 2.0}),
+        SchedulingRequest(demand={"CPU": 2.0})])
+    assert busy[0].node_id is not None and busy[1].node_id is not None
+    assert busy[2].node_id is None and not busy[2].is_infeasible
+
+
+def test_native_matches_python_on_random_workload():
+    pol_n = _native()
+    cluster, ids = _cluster(n=8, cpus=16.0)
+    rng = np.random.RandomState(0)
+    reqs = [SchedulingRequest(demand={"CPU": float(rng.randint(1, 4))})
+            for _ in range(64)]
+    res_n = pol_n.schedule_batch(cluster, reqs)
+    pol_p = HybridSchedulingPolicy(seed=0)
+    res_p = pol_p.schedule_batch(cluster, reqs)
+    # policies are randomized in tie-break; compare scheduled counts and
+    # total allocation feasibility instead of exact node identity
+    assert sum(r.node_id is not None for r in res_n) == \
+        sum(r.node_id is not None for r in res_p)
+    from collections import Counter
+    counts = Counter()
+    for req, r in zip(reqs, res_n):
+        if r.node_id is not None:
+            counts[r.node_id] += req.demand["CPU"]
+    assert all(v <= 16.0 for v in counts.values())
+
+
+def test_native_class_fill_entry_point():
+    import ctypes as ct
+    from ray_tpu._private.native_loader import scheduler_lib
+    lib = scheduler_lib()
+    assert lib is not None
+    n_nodes, n_res, n_classes = 16, 2, 3
+    avail = np.full((n_nodes, n_res), 8.0, np.float32)
+    total = avail.copy()
+    alive = np.ones(n_nodes, np.uint8)
+    demands = np.asarray([[1.0, 0.0], [2.0, 1.0], [0.5, 0.0]], np.float32)
+    counts = np.asarray([40, 10, 60], np.int32)
+    preferred = np.full(n_classes, -1, np.int32)
+    takes = np.zeros((n_classes, n_nodes), np.int32)
+    f32p, u8p, i32p = (ct.POINTER(ct.c_float), ct.POINTER(ct.c_uint8),
+                       ct.POINTER(ct.c_int32))
+    lib.rtpu_hybrid_schedule_classes(
+        avail.ctypes.data_as(f32p), total.ctypes.data_as(f32p),
+        alive.ctypes.data_as(u8p), n_nodes, n_res,
+        demands.ctypes.data_as(f32p), counts.ctypes.data_as(i32p),
+        preferred.ctypes.data_as(i32p), n_classes, ct.c_float(0.5),
+        takes.ctypes.data_as(i32p))
+    assert takes.sum(axis=1).tolist() == [40, 10, 60]
+    # no node oversubscribed
+    used = (takes[:, :, None] * demands[:, None, :]).sum(axis=0)
+    assert (used <= total + 1e-5).all()
