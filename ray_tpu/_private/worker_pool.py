@@ -186,8 +186,14 @@ class WorkerPool:
                     w.leased = True
                     w.is_actor_worker = dedicated
                     return w
+            # Dedicated (actor) workers sit outside the pool cap: actors
+            # are bounded by their resource reservations, the cap only
+            # governs the reusable task pool (otherwise a couple of
+            # actors would starve task dispatch — reference semantics:
+            # dedicated workers are not pool members).
             count = sum(1 for w in self._all.values()
-                        if w.alive and w.kind == substrate)
+                        if w.alive and w.kind == substrate
+                        and not w.is_actor_worker)
             limit = (self._max_inproc if substrate == "in_process"
                      else self._max_process)
             if count >= limit:

@@ -1,0 +1,28 @@
+"""ray_tpu.train: data-parallel training on actor gangs (the role of
+Ray Train, TPU-native: in-worker sync is jax/psum, cross-host sync is
+the host collective plane, recovery is gang restart from checkpoint)."""
+
+from ray_tpu.train._session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.checkpoint import Checkpoint, load_pytree, save_pytree
+from ray_tpu.train.trainer import (
+    CheckpointConfig,
+    DataParallelTrainer,
+    FailureConfig,
+    JaxTrainer,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+
+__all__ = [
+    "Checkpoint", "CheckpointConfig", "DataParallelTrainer",
+    "FailureConfig", "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
+    "TrainContext", "get_checkpoint", "get_context", "get_dataset_shard",
+    "load_pytree", "report", "save_pytree",
+]

@@ -1,0 +1,133 @@
+"""Worker-side training session (reference:
+``python/ray/train/_internal/session.py`` [UNVERIFIED — SURVEY.md §0]).
+
+Reports travel driver-ward over the shared filesystem (one pickle per
+``report()`` call, atomic rename) because the worker's actor thread is
+busy inside the user loop — the same reason the reference uses a
+result queue rather than an RPC back-channel.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclass
+class TrainContext:
+    world_size: int = 1
+    rank: int = 0
+    node_rank: int = 0
+    local_rank: int = 0
+    experiment_name: str = ""
+    trial_dir: str = ""
+    report_dir: str = ""
+    config: Dict[str, Any] = field(default_factory=dict)
+    collective_group: str = ""
+    datasets: Dict[str, List] = field(default_factory=dict)  # name->blocks
+    latest_checkpoint: Optional[Checkpoint] = None
+    _report_seq: int = 0
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_rank(self) -> int:
+        return self.rank
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+
+_session: Optional[TrainContext] = None
+_lock = threading.Lock()
+
+
+def init_session(ctx: TrainContext) -> None:
+    global _session
+    with _lock:
+        _session = ctx
+
+
+def shutdown_session() -> None:
+    global _session
+    with _lock:
+        _session = None
+
+
+def get_context() -> TrainContext:
+    if _session is None:
+        # driver-side / local-mode context
+        return TrainContext()
+    return _session
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_context().latest_checkpoint
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) to the trainer."""
+    ctx = get_context()
+    if not ctx.report_dir:
+        return  # local mode: nothing to deliver
+    ctx._report_seq += 1
+    payload: Dict[str, Any] = {"metrics": dict(metrics), "rank": ctx.rank,
+                               "seq": ctx._report_seq}
+    if checkpoint is not None:
+        # persist into the trial dir so it outlives the worker
+        dst = os.path.join(ctx.trial_dir,
+                           f"checkpoint_{ctx._report_seq:06d}_r{ctx.rank}")
+        if os.path.abspath(checkpoint.path) != os.path.abspath(dst):
+            shutil.copytree(checkpoint.path, dst, dirs_exist_ok=True)
+        payload["checkpoint_path"] = dst
+    fd, tmp = tempfile.mkstemp(dir=ctx.report_dir, suffix=".tmp")
+    with os.fdopen(fd, "wb") as f:
+        pickle.dump(payload, f)
+    os.rename(tmp, os.path.join(
+        ctx.report_dir, f"report_{ctx.rank:04d}_{ctx._report_seq:08d}.pkl"))
+
+
+def get_dataset_shard(name: str = "train"):
+    """Iterator factory over this worker's dataset shard blocks."""
+    from ray_tpu.data import block as blib
+
+    blocks = get_context().datasets.get(name, [])
+
+    class _Shard:
+        def iter_batches(self, *, batch_size: Optional[int] = 256,
+                         batch_format: str = "numpy"):
+            carry: List = []
+            carry_rows = 0
+            for blk in blocks:
+                if blk.num_rows == 0:
+                    continue
+                if batch_size is None:
+                    yield blib.block_to_batch(blk, batch_format)
+                    continue
+                carry.append(blk)
+                carry_rows += blk.num_rows
+                while carry_rows >= batch_size:
+                    merged = blib.concat_blocks(carry)
+                    out = blib.slice_block(merged, 0, batch_size)
+                    rest = blib.slice_block(merged, batch_size,
+                                            merged.num_rows)
+                    yield blib.block_to_batch(out, batch_format)
+                    carry = [rest] if rest.num_rows else []
+                    carry_rows = rest.num_rows
+            if carry:
+                merged = blib.concat_blocks(carry)
+                if merged.num_rows:
+                    yield blib.block_to_batch(merged, batch_format)
+
+        def count(self):
+            return sum(b.num_rows for b in blocks)
+
+    return _Shard()

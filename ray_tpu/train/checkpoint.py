@@ -1,0 +1,69 @@
+"""Checkpoint: directory abstraction + jax pytree (de)serialization.
+
+Reference: ``ray.train.Checkpoint`` (directory abstraction uploaded via
+pyarrow.fs) [UNVERIFIED — mount empty, SURVEY.md §0]. TPU-native
+extension: ``save_pytree``/``load_pytree`` write sharded ``jax.Array``
+trees — per-host shards gathered then written as npz + pickled
+treedef, off the step path (SURVEY.md §5 checkpoint row). Orbax can
+replace the serializer without touching callers.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class Checkpoint:
+    """A directory of files produced by training."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            path = tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        if os.path.abspath(path) != self.path:
+            shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    def as_directory(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            yield self.path
+        return cm()
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def save_pytree(tree: Any, directory: str, name: str = "state") -> None:
+    """Write a jax/numpy pytree: leaves as npz, structure pickled."""
+    import jax
+    os.makedirs(directory, exist_ok=True)
+    leaves, treedef = jax.tree.flatten(tree)
+    arrays = [np.asarray(leaf) for leaf in leaves]
+    np.savez(os.path.join(directory, f"{name}.npz"),
+             **{f"leaf_{i}": a for i, a in enumerate(arrays)})
+    with open(os.path.join(directory, f"{name}.treedef.pkl"), "wb") as f:
+        pickle.dump(treedef, f)
+
+
+def load_pytree(directory: str, name: str = "state") -> Any:
+    import jax
+    with open(os.path.join(directory, f"{name}.treedef.pkl"), "rb") as f:
+        treedef = pickle.load(f)
+    data = np.load(os.path.join(directory, f"{name}.npz"))
+    leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    return jax.tree.unflatten(treedef, leaves)

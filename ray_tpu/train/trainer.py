@@ -1,0 +1,278 @@
+"""DataParallelTrainer: SPMD training on an actor gang in a placement
+group, with gang restart from the last checkpoint on failure.
+
+Reference: ``python/ray/train/`` — ``DataParallelTrainer`` /
+``BackendExecutor`` / ``WorkerGroup``; ``ScalingConfig``,
+``RunConfig(FailureConfig, CheckpointConfig)``; fault tolerance =
+restart the whole worker gang from the last checkpoint
+[UNVERIFIED — mount empty, SURVEY.md §0].
+
+TPU-native notes: gradient sync INSIDE a worker is jax (psum over the
+mesh the worker drives); BETWEEN workers (one per host) the host-plane
+collective group is pre-initialized for the loop to use
+(``ctx.collective_group``). Gang restart — not per-worker restart —
+is the only correct recovery for a compiled SPMD program
+(SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import pickle
+import shutil
+import tempfile
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train._session import (
+    TrainContext,
+    get_context,
+    init_session,
+    shutdown_session,
+)
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = 1.0
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[BaseException] = None
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+
+
+@ray_tpu.remote
+class _TrainWorker:
+    """One gang member. ``run`` executes the user loop to completion."""
+
+    def _join_collective_group(self, world, rank, backend, name):
+        from ray_tpu import collective as col
+        col.init_collective_group(world, rank, backend, name,
+                                  timeout_s=120.0)
+        return rank
+
+    def run(self, loop_blob: bytes, ctx_fields: dict, blocks_by_name):
+        import cloudpickle
+        ctx = TrainContext(**ctx_fields)
+        ctx.datasets = blocks_by_name
+        init_session(ctx)
+        try:
+            loop = cloudpickle.loads(loop_blob)
+            loop(ctx.config) if _wants_arg(loop) else loop()
+            return True
+        finally:
+            shutdown_session()
+
+
+def _wants_arg(fn: Callable) -> bool:
+    import inspect
+    try:
+        return len(inspect.signature(fn).parameters) >= 1
+    except (TypeError, ValueError):
+        return True
+
+
+class DataParallelTrainer:
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self._loop = train_loop_per_worker
+        self._loop_config = train_loop_config or {}
+        self._scaling = scaling_config or ScalingConfig()
+        self._run_config = run_config or RunConfig()
+        self._datasets = datasets or {}
+        self._resume_ckpt = resume_from_checkpoint
+
+    # -- experiment dirs ---------------------------------------------------
+
+    def _trial_dir(self) -> str:
+        base = (self._run_config.storage_path
+                or os.path.join(tempfile.gettempdir(), "ray_tpu_results"))
+        name = self._run_config.name or f"train_{uuid.uuid4().hex[:8]}"
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    # -- fit ---------------------------------------------------------------
+
+    def fit(self) -> Result:
+        trial_dir = self._trial_dir()
+        failures_left = self._run_config.failure_config.max_failures
+        latest_ckpt = self._resume_ckpt
+        history: List[Dict[str, Any]] = []
+        while True:
+            try:
+                metrics, latest_ckpt = self._run_attempt(
+                    trial_dir, latest_ckpt, history)
+                return Result(metrics=metrics, checkpoint=latest_ckpt,
+                              path=trial_dir, metrics_history=history)
+            except Exception as e:
+                # keep any checkpoint reported before the crash so the
+                # next attempt resumes from it
+                attempt_ckpt = getattr(self, "_attempt_ckpt", None)
+                if attempt_ckpt is not None:
+                    latest_ckpt = attempt_ckpt
+                if failures_left == 0:
+                    return Result(metrics=history[-1] if history else {},
+                                  checkpoint=latest_ckpt, path=trial_dir,
+                                  error=e, metrics_history=history)
+                if failures_left > 0:
+                    failures_left -= 1
+
+    def _run_attempt(self, trial_dir: str,
+                     latest_ckpt: Optional[Checkpoint],
+                     history: List[Dict[str, Any]]):
+        from ray_tpu.util.placement_group import (
+            placement_group, remove_placement_group)
+
+        scfg = self._scaling
+        n = scfg.num_workers
+        res = scfg.worker_resources()
+        report_dir = tempfile.mkdtemp(prefix="rtpu_reports_")
+        group_name = f"train_{uuid.uuid4().hex[:8]}"
+
+        pg = placement_group([dict(res) for _ in range(n)],
+                             strategy=scfg.placement_strategy)
+        if not pg.wait(60):
+            remove_placement_group(pg)
+            raise RuntimeError(
+                f"could not reserve {n} x {res} for the worker gang")
+        workers = []
+        seen = 0
+        try:
+            kw: Dict[str, Any] = {}
+            if "CPU" in res:
+                kw["num_cpus"] = res["CPU"]
+            if "TPU" in res:
+                kw["num_tpus"] = res["TPU"]
+            workers = [
+                _TrainWorker.options(
+                    placement_group=pg, placement_group_bundle_index=i,
+                    **kw).remote()
+                for i in range(n)]
+            # host-plane collective group for the loop to use
+            ray_tpu.get([w._join_collective_group.remote(
+                n, i, "shm", group_name)
+                for i, w in enumerate(workers)], timeout=120)
+
+            shards = self._shard_datasets(n)
+            import cloudpickle
+            blob = cloudpickle.dumps(self._loop)
+            refs = []
+            for i, w in enumerate(workers):
+                ctx_fields = dict(
+                    world_size=n, rank=i, local_rank=i,
+                    experiment_name=self._run_config.name or "",
+                    trial_dir=trial_dir, report_dir=report_dir,
+                    config=dict(self._loop_config),
+                    collective_group=group_name,
+                    latest_checkpoint=latest_ckpt)
+                refs.append(w.run.remote(blob, ctx_fields, shards[i]))
+
+            seen = 0
+            while True:
+                ready, not_ready = ray_tpu.wait(
+                    refs, num_returns=len(refs), timeout=0.2)
+                seen, latest_ckpt = self._drain_reports(
+                    report_dir, seen, history, latest_ckpt)
+                if len(ready) == len(refs):
+                    ray_tpu.get(ready)  # surface worker exceptions
+                    break
+            seen, latest_ckpt = self._drain_reports(
+                report_dir, seen, history, latest_ckpt)
+            metrics = history[-1] if history else {}
+            return metrics, latest_ckpt
+        finally:
+            try:
+                seen, latest_ckpt = self._drain_reports(
+                    report_dir, seen, history, latest_ckpt)
+            except Exception:
+                pass
+            self._attempt_ckpt = latest_ckpt
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+            remove_placement_group(pg)
+            shutil.rmtree(report_dir, ignore_errors=True)
+
+    def _drain_reports(self, report_dir: str, seen: int,
+                       history: List[Dict[str, Any]],
+                       latest_ckpt: Optional[Checkpoint]):
+        files = sorted(glob.glob(os.path.join(report_dir, "report_*.pkl")))
+        for path in files[seen:]:
+            try:
+                with open(path, "rb") as f:
+                    payload = pickle.load(f)
+            except (EOFError, pickle.UnpicklingError):
+                continue
+            if payload["rank"] == 0:
+                history.append(payload["metrics"])
+            if "checkpoint_path" in payload and payload["rank"] == 0:
+                latest_ckpt = Checkpoint(payload["checkpoint_path"])
+        return len(files), latest_ckpt
+
+    def _shard_datasets(self, n: int) -> List[Dict[str, List]]:
+        """Split every dataset into n contiguous block lists (materialized
+        — blocks ship to workers zero-copy through the shm store)."""
+        shards: List[Dict[str, List]] = [dict() for _ in range(n)]
+        for name, ds in self._datasets.items():
+            blocks = list(ds.iter_blocks())
+            from ray_tpu.data import block as blib
+            merged = blib.concat_blocks(blocks)
+            rows = merged.num_rows
+            per = rows // n
+            for i in range(n):
+                start = i * per
+                end = rows if i == n - 1 else (i + 1) * per
+                shards[i][name] = [blib.slice_block(merged, start, end)]
+        return shards
+
+
+class JaxTrainer(DataParallelTrainer):
+    """Alias with TPU defaults (the role TorchTrainer plays upstream)."""

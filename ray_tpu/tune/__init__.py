@@ -1,0 +1,35 @@
+"""ray_tpu.tune: experiment runner (Tuner/TuneController) with
+ASHA/HyperBand/Median/PBT schedulers and grid/random search over trial
+actors. Reference surface: python/ray/tune [SURVEY.md §2.4]."""
+
+from ray_tpu.train._session import get_checkpoint, report
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
+
+__all__ = [
+    "ASHAScheduler", "BasicVariantGenerator", "Checkpoint",
+    "FIFOScheduler", "HyperBandScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining", "ResultGrid", "Searcher", "Trial",
+    "TrialScheduler", "TuneConfig", "Tuner", "choice", "get_checkpoint",
+    "grid_search", "loguniform", "quniform", "randint", "report",
+    "sample_from", "uniform",
+]

@@ -1,0 +1,196 @@
+"""Trial schedulers: early stopping + population-based training.
+
+Reference: ``python/ray/tune/schedulers/`` — ``ASHAScheduler``
+(async successive halving), ``HyperBandScheduler``,
+``MedianStoppingRule``, ``PopulationBasedTraining`` [UNVERIFIED —
+mount empty, SURVEY.md §0].
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+# PBT only: restart this trial from another's checkpoint w/ new config
+EXPLOIT = "EXPLOIT"
+
+
+class TrialScheduler:
+    def on_trial_result(self, trial, result: Dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict]) -> None:
+        pass
+
+    def exploit_info(self, trial):
+        """PBT: (source_trial, new_config) for EXPLOIT decisions."""
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Async successive halving: at each rung, only results in the top
+    1/reduction_factor of that rung's recorded scores continue."""
+
+    def __init__(self, *, metric: str = "score", mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4, time_attr: str =
+                 "training_iteration"):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        self._rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self._rungs.append(t)
+            t *= reduction_factor
+        # rung level -> recorded scores
+        self._recorded: Dict[int, List[float]] = defaultdict(list)
+
+    def _score(self, result: Dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial, result: Dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t >= self.max_t:
+            return STOP
+        score = self._score(result)
+        decision = CONTINUE
+        for rung in self._rungs:
+            if t == rung:
+                recorded = self._recorded[rung]
+                recorded.append(score)
+                k = max(1, len(recorded) // self.rf)
+                cutoff = sorted(recorded, reverse=True)[k - 1]
+                if score < cutoff:
+                    decision = STOP
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running mean falls below the median of other
+    trials' means at the same step."""
+
+    def __init__(self, *, metric: str = "score", mode: str = "max",
+                 grace_period: int = 1,
+                 time_attr: str = "training_iteration",
+                 min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.time_attr = time_attr
+        self.min_samples = min_samples_required
+        self._means: Dict[Any, List[float]] = defaultdict(list)
+
+    def on_trial_result(self, trial, result: Dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        v = float(result[self.metric])
+        if self.mode == "min":
+            v = -v
+        self._means[trial.trial_id].append(v)
+        if t < self.grace or len(self._means) < self.min_samples:
+            return CONTINUE
+        my_mean = sum(self._means[trial.trial_id]) / len(
+            self._means[trial.trial_id])
+        others = [sum(vs) / len(vs) for tid, vs in self._means.items()
+                  if tid != trial.trial_id and vs]
+        if len(others) + 1 < self.min_samples:
+            return CONTINUE
+        others_sorted = sorted(others)
+        median = others_sorted[len(others_sorted) // 2]
+        return STOP if my_mean < median else CONTINUE
+
+
+class HyperBandScheduler(ASHAScheduler):
+    """v1: the asynchronous formulation (ASHA) with HyperBand's default
+    knobs — the reference's own docs recommend ASHA over sync
+    HyperBand for exactly this reason."""
+
+    def __init__(self, *, metric: str = "score", mode: str = "max",
+                 max_t: int = 81, reduction_factor: int = 3, **kw):
+        super().__init__(metric=metric, mode=mode, max_t=max_t,
+                         grace_period=1,
+                         reduction_factor=reduction_factor, **kw)
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """At each perturbation interval, bottom-quantile trials EXPLOIT a
+    top-quantile trial: clone its checkpoint and mutate its config."""
+
+    def __init__(self, *, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 time_attr: str = "training_iteration",
+                 seed: Optional[int] = None):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.time_attr = time_attr
+        self._rng = random.Random(seed)
+        self._latest: Dict[Any, Dict] = {}   # trial_id -> last result
+        self._trials: Dict[Any, Any] = {}
+        self._exploit: Dict[Any, Any] = {}   # trial_id -> (src, config)
+
+    def _score_of(self, result: Dict) -> float:
+        v = float(result[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, trial, result: Dict) -> str:
+        self._latest[trial.trial_id] = result
+        self._trials[trial.trial_id] = trial
+        t = int(result.get(self.time_attr, 0))
+        if t == 0 or t % self.interval != 0:
+            return CONTINUE
+        if len(self._latest) < 2:
+            return CONTINUE
+        ranked = sorted(self._latest.items(),
+                        key=lambda kv: self._score_of(kv[1]))
+        n = len(ranked)
+        k = max(1, int(n * self.quantile))
+        bottom = [tid for tid, _ in ranked[:k]]
+        top = [tid for tid, _ in ranked[-k:]]
+        if trial.trial_id in bottom and top:
+            src_id = self._rng.choice(top)
+            if src_id != trial.trial_id:
+                src = self._trials[src_id]
+                new_cfg = self._mutate(dict(src.config))
+                self._exploit[trial.trial_id] = (src, new_cfg)
+                return EXPLOIT
+        return CONTINUE
+
+    def _mutate(self, config: Dict) -> Dict:
+        from ray_tpu.tune.search import Domain
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_p:
+                if isinstance(spec, Domain):
+                    config[key] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    config[key] = self._rng.choice(spec)
+                elif callable(spec):
+                    config[key] = spec()
+            else:
+                cur = config.get(key)
+                if isinstance(cur, (int, float)):
+                    factor = self._rng.choice([0.8, 1.2])
+                    config[key] = type(cur)(cur * factor)
+        return config
+
+    def exploit_info(self, trial):
+        return self._exploit.pop(trial.trial_id, None)

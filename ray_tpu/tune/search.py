@@ -1,0 +1,144 @@
+"""Search space + trial generation.
+
+Reference: ``python/ray/tune/search/`` — ``BasicVariantGenerator``
+(grid + random sampling), sample domains (``tune.choice/uniform/
+loguniform/randint/grid_search``) [UNVERIFIED — mount empty,
+SURVEY.md §0]. External searchers (Optuna, HyperOpt, ...) plug in at
+the ``Searcher`` seam; none of those libraries are vendored here.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+@dataclass
+class Choice(Domain):
+    values: List[Any]
+
+    def sample(self, rng):
+        return rng.choice(self.values)
+
+
+@dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        import math
+        return math.exp(rng.uniform(math.log(self.low),
+                                    math.log(self.high)))
+
+
+@dataclass
+class RandInt(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class QUniform(Domain):
+    low: float
+    high: float
+    q: float
+
+    def sample(self, rng):
+        v = rng.uniform(self.low, self.high)
+        return round(v / self.q) * self.q
+
+
+@dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def choice(values): return Choice(list(values))
+def uniform(low, high): return Uniform(low, high)
+def loguniform(low, high): return LogUniform(low, high)
+def randint(low, high): return RandInt(low, high)
+def quniform(low, high, q): return QUniform(low, high, q)
+def grid_search(values): return GridSearch(list(values))
+
+
+def sample_from(fn: Callable[[Dict], Any]):
+    return _SampleFrom(fn)
+
+
+@dataclass
+class _SampleFrom:
+    fn: Callable
+
+
+class Searcher:
+    """Seam for pluggable search algorithms."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict],
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Cross-product of grid axes × num_samples random draws."""
+
+    def __init__(self, param_space: Dict, num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self._space = param_space
+        self._num_samples = num_samples
+        self._rng = random.Random(seed)
+        self._variants = self._expand()
+        self._idx = 0
+
+    def _expand(self) -> List[Dict]:
+        grid_keys = [k for k, v in self._space.items()
+                     if isinstance(v, GridSearch)]
+        grids = [self._space[k].values for k in grid_keys]
+        out: List[Dict] = []
+        for combo in itertools.product(*grids) if grids else [()]:
+            for _ in range(self._num_samples):
+                cfg: Dict[str, Any] = {}
+                for k, v in self._space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self._rng)
+                    elif isinstance(v, _SampleFrom):
+                        cfg[k] = v.fn(cfg)
+                    else:
+                        cfg[k] = v
+                out.append(cfg)
+        return out
+
+    @property
+    def total(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict]:
+        if self._idx >= len(self._variants):
+            return None
+        cfg = self._variants[self._idx]
+        self._idx += 1
+        return cfg

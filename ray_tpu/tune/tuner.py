@@ -1,0 +1,356 @@
+"""Tuner + TuneController: trials as actors, schedulers deciding
+promote/stop/exploit, resumable experiment state.
+
+Reference: ``python/ray/tune/tuner.py`` +
+``tune/execution/tune_controller.py`` + ``tune/experiment/trial.py``
+[UNVERIFIED — mount empty, SURVEY.md §0]. Call stack mirrored from
+SURVEY.md §3.5: suggest → acquire resources → trial actor → results
+stream back → scheduler decision → checkpoint per trial →
+experiment-state snapshot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train._session import TrainContext, init_session, \
+    shutdown_session
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.trainer import RunConfig
+from ray_tpu.tune.schedulers import (
+    CONTINUE,
+    EXPLOIT,
+    STOP,
+    FIFOScheduler,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    metric: Optional[str] = None
+    mode: str = "max"
+    scheduler: Optional[TrialScheduler] = None
+    search_alg: Optional[Searcher] = None
+    trial_resources: Optional[Dict[str, float]] = None
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: Dict):
+        self.trial_id = trial_id
+        self.config = config
+        self.status = "PENDING"   # PENDING|RUNNING|TERMINATED|ERROR
+        self.results: List[Dict] = []
+        self.checkpoint: Optional[Checkpoint] = None
+        self.error: Optional[str] = None
+        self.actor = None
+        self.run_ref = None
+        self.report_dir: Optional[str] = None
+        self.seen_reports = 0
+        self.restore_from: Optional[Checkpoint] = None
+
+    @property
+    def last_result(self) -> Dict:
+        return self.results[-1] if self.results else {}
+
+
+@ray_tpu.remote
+class _TrialActor:
+    def run(self, fn_blob: bytes, config: Dict, ctx_fields: dict):
+        import cloudpickle
+        ctx = TrainContext(**ctx_fields)
+        ctx.config = config
+        init_session(ctx)
+        try:
+            fn = cloudpickle.loads(fn_blob)
+            out = fn(config)
+            if isinstance(out, dict):
+                # function returned final metrics without report()
+                from ray_tpu.train._session import report
+                report(out)
+            return True
+        finally:
+            shutdown_session()
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial], metric: Optional[str],
+                 mode: str, path: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+        self.experiment_path = path
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __iter__(self):
+        from ray_tpu.train.trainer import Result
+        for t in self._trials:
+            yield Result(metrics=t.last_result, checkpoint=t.checkpoint,
+                         path=self.experiment_path,
+                         error=RuntimeError(t.error) if t.error else None,
+                         metrics_history=t.results)
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self._trials if t.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None):
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set in TuneConfig or here)")
+        best, best_v = None, None
+        from ray_tpu.train.trainer import Result
+        for t in self._trials:
+            vals = [r[metric] for r in t.results if metric in r]
+            if not vals:
+                continue
+            v = max(vals) if mode == "max" else min(vals)
+            if best_v is None or (v > best_v if mode == "max"
+                                  else v < best_v):
+                best, best_v = t, v
+        if best is None:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return Result(metrics=best.last_result, checkpoint=best.checkpoint,
+                      path=self.experiment_path,
+                      metrics_history=best.results)
+
+    def get_dataframe(self):
+        import pandas as pd
+        rows = []
+        for t in self._trials:
+            row = dict(t.last_result)
+            row["trial_id"] = t.trial_id
+            row.update({f"config/{k}": v for k, v in t.config.items()})
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+
+    def _experiment_dir(self) -> str:
+        base = (self._run_config.storage_path
+                or os.path.join(tempfile.gettempdir(), "ray_tpu_results"))
+        name = self._run_config.name or f"tune_{uuid.uuid4().hex[:8]}"
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def fit(self) -> ResultGrid:
+        tc = self._tune_config
+        search = tc.search_alg or BasicVariantGenerator(
+            self._param_space, num_samples=tc.num_samples)
+        scheduler = tc.scheduler or FIFOScheduler()
+        exp_dir = self._experiment_dir()
+        controller = TuneController(
+            trainable=self._trainable, search=search, scheduler=scheduler,
+            max_concurrent=tc.max_concurrent_trials,
+            resources=tc.trial_resources or {"CPU": 1.0},
+            exp_dir=exp_dir)
+        trials = controller.run()
+        self._snapshot(exp_dir, trials)
+        return ResultGrid(trials, tc.metric, tc.mode, exp_dir)
+
+    def _snapshot(self, exp_dir: str, trials: List[Trial]) -> None:
+        state = [{
+            "trial_id": t.trial_id, "config": t.config,
+            "status": t.status, "results": t.results,
+            "checkpoint": t.checkpoint.path if t.checkpoint else None,
+            "error": t.error,
+        } for t in trials]
+        with open(os.path.join(exp_dir, "experiment_state.json"),
+                  "w") as f:
+            json.dump(state, f, default=str)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                metric: Optional[str] = None, mode: str = "max"
+                ) -> ResultGrid:
+        """Load a finished/interrupted experiment's state."""
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            state = json.load(f)
+        trials = []
+        for s in state:
+            t = Trial(s["trial_id"], s["config"])
+            t.status = s["status"]
+            t.results = s["results"]
+            t.error = s.get("error")
+            if s.get("checkpoint"):
+                t.checkpoint = Checkpoint(s["checkpoint"])
+            trials.append(t)
+        return ResultGrid(trials, metric, mode, path)
+
+
+class TuneController:
+    """The event loop: start trials up to the concurrency cap, poll
+    their report streams, apply scheduler decisions."""
+
+    def __init__(self, trainable, search: Searcher,
+                 scheduler: TrialScheduler, max_concurrent: int,
+                 resources: Dict[str, float], exp_dir: str):
+        import cloudpickle
+        self._fn_blob = cloudpickle.dumps(trainable)
+        self._search = search
+        self._scheduler = scheduler
+        self._max_concurrent = max_concurrent
+        self._resources = resources
+        self._exp_dir = exp_dir
+        self._counter = 0
+
+    def run(self) -> List[Trial]:
+        trials: List[Trial] = []
+        running: List[Trial] = []
+        exhausted = False
+        while True:
+            # refill
+            while not exhausted and len(running) < self._max_concurrent:
+                trial = self._next_trial()
+                if trial is None:
+                    exhausted = True
+                    break
+                trials.append(trial)
+                self._start(trial)
+                running.append(trial)
+            if not running and exhausted:
+                break
+            # poll
+            refs = [t.run_ref for t in running]
+            ray_tpu.wait(refs, num_returns=1, timeout=0.2)
+            still: List[Trial] = []
+            for t in running:
+                self._drain(t)
+                decision = self._apply_scheduler(t)
+                done = self._check_done(t)
+                if decision == STOP and not done:
+                    self._stop_trial(t, "TERMINATED")
+                elif decision == EXPLOIT and not done:
+                    self._exploit(t)
+                    still.append(t)
+                elif not done:
+                    still.append(t)
+            running = still
+        return trials
+
+    def _next_trial(self) -> Optional[Trial]:
+        trial_id = f"trial_{self._counter:05d}"
+        config = self._search.suggest(trial_id)
+        if config is None:
+            return None
+        self._counter += 1
+        return Trial(trial_id, config)
+
+    def _start(self, trial: Trial) -> None:
+        kw: Dict[str, Any] = {}
+        if "CPU" in self._resources:
+            kw["num_cpus"] = self._resources["CPU"]
+        if "TPU" in self._resources:
+            kw["num_tpus"] = self._resources["TPU"]
+        trial.report_dir = tempfile.mkdtemp(prefix="rtpu_trial_")
+        trial.seen_reports = 0
+        trial.actor = _TrialActor.options(**kw).remote()
+        trial_dir = os.path.join(self._exp_dir, trial.trial_id)
+        os.makedirs(trial_dir, exist_ok=True)
+        ctx_fields = dict(world_size=1, rank=0,
+                          trial_dir=trial_dir,
+                          report_dir=trial.report_dir,
+                          latest_checkpoint=trial.restore_from)
+        trial.run_ref = trial.actor.run.remote(
+            self._fn_blob, trial.config, ctx_fields)
+        trial.status = "RUNNING"
+
+    def _drain(self, trial: Trial) -> None:
+        files = sorted(glob.glob(
+            os.path.join(trial.report_dir, "report_*.pkl")))
+        for path in files[trial.seen_reports:]:
+            try:
+                with open(path, "rb") as f:
+                    payload = pickle.load(f)
+            except (EOFError, pickle.UnpicklingError):
+                continue
+            metrics = payload["metrics"]
+            metrics.setdefault("training_iteration",
+                               len(trial.results) + 1)
+            trial.results.append(metrics)
+            if "checkpoint_path" in payload:
+                trial.checkpoint = Checkpoint(payload["checkpoint_path"])
+            trial._new_results = getattr(trial, "_new_results", [])
+            trial._new_results.append(metrics)
+        trial.seen_reports = len(files)
+
+    def _apply_scheduler(self, trial: Trial) -> str:
+        decision = CONTINUE
+        new = getattr(trial, "_new_results", [])
+        trial._new_results = []
+        for metrics in new:
+            d = self._scheduler.on_trial_result(trial, metrics)
+            if d in (STOP, EXPLOIT):
+                decision = d
+        return decision
+
+    def _check_done(self, trial: Trial) -> bool:
+        ready, _ = ray_tpu.wait([trial.run_ref], num_returns=1, timeout=0)
+        if not ready:
+            return False
+        self._drain(trial)
+        try:
+            ray_tpu.get(trial.run_ref)
+            trial.status = "TERMINATED"
+        except Exception as e:
+            trial.status = "ERROR"
+            trial.error = str(e)
+        self._search.on_trial_complete(trial.trial_id, trial.last_result,
+                                       error=trial.status == "ERROR")
+        self._scheduler.on_trial_complete(trial, trial.last_result)
+        self._cleanup_actor(trial)
+        return True
+
+    def _stop_trial(self, trial: Trial, status: str) -> None:
+        trial.status = status
+        self._cleanup_actor(trial)
+        self._scheduler.on_trial_complete(trial, trial.last_result)
+
+    def _exploit(self, trial: Trial) -> None:
+        """PBT: restart this trial from the exploit target's checkpoint
+        with the mutated config."""
+        info = self._scheduler.exploit_info(trial)
+        if info is None:
+            return
+        src, new_config = info
+        self._cleanup_actor(trial)
+        trial.config = new_config
+        trial.restore_from = src.checkpoint
+        self._start(trial)
+
+    def _cleanup_actor(self, trial: Trial) -> None:
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        if trial.report_dir:
+            shutil.rmtree(trial.report_dir, ignore_errors=True)

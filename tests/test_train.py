@@ -1,0 +1,161 @@
+"""ray_tpu.train: worker gangs, reporting, checkpoints, gang restart
+(reference: python/ray/train tests)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (
+    Checkpoint,
+    DataParallelTrainer,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture(autouse=True)
+def _runtime(ray_start_regular):
+    yield
+
+
+def test_simple_gang_reports_metrics():
+    def loop(config):
+        ctx = train.get_context()
+        for step in range(3):
+            train.report({"step": step, "rank": ctx.get_rank(),
+                          "world": ctx.get_world_size()})
+
+    res = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2)).fit()
+    assert res.error is None
+    assert res.metrics["step"] == 2
+    assert res.metrics["world"] == 2
+    assert len(res.metrics_history) == 3
+
+
+def test_collective_allreduce_between_workers():
+    def loop(config):
+        from ray_tpu import collective as col
+        ctx = train.get_context()
+        out = col.allreduce(np.asarray([float(ctx.get_rank() + 1)]),
+                            ctx.collective_group)
+        train.report({"sum": float(out[0])})
+
+    res = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2)).fit()
+    assert res.error is None
+    assert res.metrics["sum"] == 3.0
+
+
+def test_dataset_ingest_sharding():
+    from ray_tpu import data as rdata
+
+    def loop(config):
+        ctx = train.get_context()
+        shard = train.get_dataset_shard("train")
+        total = sum(int(np.sum(b["id"]))
+                    for b in shard.iter_batches(batch_size=8))
+        train.report({"total": total, "n": shard.count()})
+
+    ds = rdata.range(64, parallelism=4)
+    res = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": ds}).fit()
+    assert res.error is None
+    assert res.metrics["n"] == 32
+
+
+def test_checkpoint_report_and_restore(tmp_path):
+    def loop(config):
+        import jax.numpy as jnp
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            state = train.load_pytree(ckpt.path)
+            start = int(state["step"]) + 1
+        for step in range(start, start + 2):
+            d = tempfile.mkdtemp()
+            train.save_pytree({"step": jnp.asarray(step)}, d)
+            train.report({"step": step},
+                         checkpoint=Checkpoint.from_directory(d))
+
+    run = RunConfig(name="ckpt_test", storage_path=str(tmp_path))
+    res = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=run).fit()
+    assert res.error is None
+    assert res.metrics["step"] == 1
+    assert res.checkpoint is not None
+
+    res2 = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="ckpt_test2",
+                             storage_path=str(tmp_path)),
+        resume_from_checkpoint=res.checkpoint).fit()
+    assert res2.error is None
+    assert res2.metrics["step"] == 3
+
+
+def test_gang_restart_on_failure(tmp_path):
+    marker = str(tmp_path / "fail_once")
+
+    def loop(config):
+        ctx = train.get_context()
+        ckpt = train.get_checkpoint()
+        start = 0
+        if ckpt is not None:
+            state = train.load_pytree(ckpt.path)
+            start = int(state["step"]) + 1
+        for step in range(start, 4):
+            d = tempfile.mkdtemp()
+            train.save_pytree({"step": np.asarray(step)}, d)
+            train.report({"step": step, "restarted": start > 0},
+                         checkpoint=train.Checkpoint.from_directory(d))
+            if step == 1 and not os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                raise RuntimeError("injected failure")
+
+    res = DataParallelTrainer(
+        loop, train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=2))).fit()
+    assert res.error is None
+    assert res.metrics["step"] == 3
+    assert res.metrics["restarted"] is True
+
+
+def test_jax_training_loop_learns():
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(128, 4), jnp.float32)
+        true_w = jnp.asarray([1.0, -2.0, 3.0, 0.5])
+        y = x @ true_w
+        w = jnp.zeros(4)
+        tx = optax.sgd(0.1)
+        opt = tx.init(w)
+
+        @jax.jit
+        def step(w, opt):
+            loss, g = jax.value_and_grad(
+                lambda w: jnp.mean((x @ w - y) ** 2))(w)
+            up, opt = tx.update(g, opt)
+            return optax.apply_updates(w, up), opt, loss
+
+        for i in range(60):
+            w, opt, loss = step(w, opt)
+        train.report({"loss": float(loss)})
+
+    res = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1)).fit()
+    assert res.error is None
+    assert res.metrics["loss"] < 1e-2

@@ -1,0 +1,129 @@
+"""ray_tpu.tune: Tuner, search spaces, ASHA early stopping, PBT
+(reference: python/ray/tune tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import TuneConfig, Tuner
+
+
+@pytest.fixture(autouse=True)
+def _runtime(ray_start_regular):
+    yield
+
+
+def test_grid_and_random_search():
+    def trainable(config):
+        tune.report({"score": config["a"] * 10 + config["b"]})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"a": tune.grid_search([1, 2, 3]),
+                     "b": tune.uniform(0, 1)},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               max_concurrent_trials=3))
+    grid = tuner.fit()
+    assert len(grid) == 3
+    best = grid.get_best_result()
+    assert best.metrics["score"] > 30
+
+
+def test_num_samples_and_dataframe():
+    def trainable(config):
+        tune.report({"score": config["x"] ** 2})
+
+    grid = Tuner(
+        trainable, param_space={"x": tune.uniform(-1, 1)},
+        tune_config=TuneConfig(num_samples=5, metric="score",
+                               mode="min")).fit()
+    assert len(grid) == 5
+    df = grid.get_dataframe()
+    assert len(df) == 5 and "config/x" in df.columns
+
+
+def test_asha_stops_bad_trials():
+    def trainable(config):
+        for i in range(1, 9):
+            tune.report({"score": config["lr"] * i,
+                         "training_iteration": i})
+
+    sched = tune.ASHAScheduler(metric="score", mode="max", max_t=8,
+                               grace_period=2, reduction_factor=2)
+    grid = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.1, 0.5, 1.0, 2.0])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               scheduler=sched,
+                               max_concurrent_trials=4)).fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] == pytest.approx(2.0 * 8)
+    # at least one weak trial got fewer than max_t results
+    lens = [len(r.metrics_history) for r in grid]
+    assert min(lens) < 8
+
+
+def test_trial_error_is_captured():
+    def trainable(config):
+        if config["x"] == 1:
+            raise ValueError("boom")
+        tune.report({"score": 1.0})
+
+    grid = Tuner(
+        trainable, param_space={"x": tune.grid_search([0, 1])},
+        tune_config=TuneConfig(metric="score", mode="max")).fit()
+    assert len(grid.errors) == 1
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 1.0
+
+
+def test_tuner_restore(tmp_path):
+    def trainable(config):
+        tune.report({"score": config["x"]})
+
+    from ray_tpu.train.trainer import RunConfig
+    Tuner(
+        trainable, param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="exp1",
+                             storage_path=str(tmp_path))).fit()
+    grid = Tuner.restore(str(tmp_path / "exp1"), trainable,
+                         metric="score", mode="max")
+    assert len(grid) == 2
+    assert grid.get_best_result().metrics["score"] == 2
+
+
+def test_pbt_exploits_checkpoints(tmp_path):
+    import tempfile
+    from ray_tpu.train import save_pytree, load_pytree
+
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        theta, start = 0.0, 1
+        if ckpt is not None:
+            state = load_pytree(ckpt.path)
+            theta, start = float(state["theta"]), int(state["iter"]) + 1
+        for i in range(start, 13):
+            theta += config["lr"]
+            d = tempfile.mkdtemp()
+            save_pytree({"theta": np.asarray(theta),
+                         "iter": np.asarray(i)}, d)
+            tune.report({"score": theta, "training_iteration": i},
+                        checkpoint=tune.Checkpoint.from_directory(d))
+
+    sched = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=4,
+        hyperparam_mutations={"lr": tune.uniform(0.1, 1.0)}, seed=0)
+    grid = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 1.0])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               scheduler=sched,
+                               max_concurrent_trials=2)).fit()
+    best = grid.get_best_result()
+    # the weak trial (lr=0.01) should have been pulled up by exploiting
+    scores = sorted(r.metrics_history[-1]["score"] for r in grid
+                    if r.metrics_history)
+    assert scores[-1] >= 11.0
+    assert best.metrics["score"] >= 11.0
