@@ -80,44 +80,56 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
         avail = self._matrices(cluster)
         n_nodes, n_res = avail.shape
         node_index = {nid: i for i, nid in enumerate(self._node_order)}
-        nreq = len(requests)
-        demands = np.zeros((nreq, n_res), np.float32)
-        preferred = np.full(nreq, -1, np.int32)
+        # Requests naming a resource no node has are infeasible outright.
+        # They must NOT reach the native loop: a partial demand row would
+        # be allocated from the shared batch-availability view, spuriously
+        # denying capacity to later requests in the same batch. Filter
+        # them out and splice results back by position.
         unknown: Dict[int, bool] = {}
+        kept: List[int] = []
         for t, req in enumerate(requests):
+            for k in req.demand:
+                if k not in self._res_names:
+                    unknown[t] = True
+                    break
+            if t not in unknown:
+                kept.append(t)
+        nreq = len(kept)
+        demands = np.zeros((max(nreq, 1), n_res), np.float32)
+        preferred = np.full(max(nreq, 1), -1, np.int32)
+        for row, t in enumerate(kept):
+            req = requests[t]
             for k, v in req.demand.items():
-                try:
-                    demands[t, self._res_names.index(k)] = v
-                except ValueError:
-                    unknown[t] = True  # resource no node has: infeasible
+                demands[row, self._res_names.index(k)] = v
             if req.preferred_node is not None and not req.avoid_local:
-                preferred[t] = node_index.get(req.preferred_node, -1)
-        out_nodes = np.empty(nreq, np.int32)
-        out_inf = np.empty(nreq, np.uint8)
-        f32p = ct.POINTER(ct.c_float)
-        u8p = ct.POINTER(ct.c_uint8)
-        i32p = ct.POINTER(ct.c_int32)
-        self._lib.rtpu_hybrid_schedule(
-            avail.ctypes.data_as(f32p),
-            self._total.ctypes.data_as(f32p),
-            self._alive.ctypes.data_as(u8p),
-            n_nodes, n_res,
-            demands.ctypes.data_as(f32p),
-            preferred.ctypes.data_as(i32p),
-            nreq, ct.c_float(self._threshold), self._top_k_abs,
-            ct.c_float(self._top_k_frac), self._seed,
-            out_nodes.ctypes.data_as(i32p),
-            out_inf.ctypes.data_as(u8p))
-        results: List[SchedulingResult] = []
-        for t in range(nreq):
-            if t in unknown:
-                results.append(SchedulingResult(None, is_infeasible=True))
-            elif out_nodes[t] < 0:
-                results.append(SchedulingResult(
-                    None, is_infeasible=bool(out_inf[t])))
+                preferred[row] = node_index.get(req.preferred_node, -1)
+        out_nodes = np.empty(max(nreq, 1), np.int32)
+        out_inf = np.empty(max(nreq, 1), np.uint8)
+        if nreq:
+            f32p = ct.POINTER(ct.c_float)
+            u8p = ct.POINTER(ct.c_uint8)
+            i32p = ct.POINTER(ct.c_int32)
+            self._lib.rtpu_hybrid_schedule(
+                avail.ctypes.data_as(f32p),
+                self._total.ctypes.data_as(f32p),
+                self._alive.ctypes.data_as(u8p),
+                n_nodes, n_res,
+                demands.ctypes.data_as(f32p),
+                preferred.ctypes.data_as(i32p),
+                nreq, ct.c_float(self._threshold), self._top_k_abs,
+                ct.c_float(self._top_k_frac), self._seed,
+                out_nodes.ctypes.data_as(i32p),
+                out_inf.ctypes.data_as(u8p))
+        results: List[SchedulingResult] = [
+            SchedulingResult(None, is_infeasible=True)
+            for _ in range(len(requests))]
+        for row, t in enumerate(kept):
+            if out_nodes[row] < 0:
+                results[t] = SchedulingResult(
+                    None, is_infeasible=bool(out_inf[row]))
             else:
-                results.append(SchedulingResult(
-                    self._node_order[out_nodes[t]]))
+                results[t] = SchedulingResult(
+                    self._node_order[out_nodes[row]])
         return results
 
 

@@ -126,8 +126,16 @@ def _split_fn_factory(kind: str, n: int, kwargs: Dict):
             col = blk.column(key).to_numpy(zero_copy_only=False)
             idx = np.searchsorted(boundaries, col, side="right")
         elif kind == "groupby":
+            # Process-stable partitioning: split tasks run in separate
+            # worker processes with independent PYTHONHASHSEEDs, so
+            # Python's hash() would scatter equal str/bytes keys across
+            # partitions. crc32 over a canonical encoding is stable.
+            import zlib
             col = blk.column(key).to_numpy(zero_copy_only=False)
-            idx = np.asarray([hash(x) % n for x in col.tolist()])
+            idx = np.asarray([
+                zlib.crc32(x if isinstance(x, bytes)
+                           else str(x).encode()) % n
+                for x in col.tolist()])
         else:
             raise ValueError(kind)
         order = np.argsort(idx, kind="stable")

@@ -64,21 +64,26 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
 
     def body(j, carry):
         o, m, l = carry
-        k_blk = k_ref[pl.ds(j * block_k, block_k), :]
-        v_blk = v_ref[pl.ds(j * block_k, block_k), :]
+        # pl.ds clamps the start when the final block would run past
+        # seq_k, re-reading earlier KV rows. Label positions from the
+        # CLAMPED start and mask rows already covered by prior blocks,
+        # so seq lengths not divisible by block_k stay exact.
+        start = jnp.minimum(j * block_k, seq_k - block_k)
+        k_blk = k_ref[pl.ds(start, block_k), :]
+        v_blk = v_ref[pl.ds(start, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk.astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)      # [block_q, block_k]
+        k_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos >= j * block_k
         if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            mask = q_pos >= k_pos
-            s = jnp.where(mask, s, -1e30)
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, -1e30)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         p = jnp.exp(s - m_new[:, None])
-        if causal:
-            p = jnp.where(mask, p, 0.0)
+        p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1)
         pv = jax.lax.dot_general(

@@ -20,6 +20,12 @@ from typing import Any, Dict, List, Optional
 from ray_tpu.train.checkpoint import Checkpoint
 
 
+class StopTrial(Exception):
+    """Raised inside ``report()`` when the controller has requested this
+    trial stop (e.g. an ASHA rung decision). User training loops don't
+    need to catch it — the trial actor does and exits cleanly."""
+
+
 @dataclass
 class TrainContext:
     world_size: int = 1
@@ -33,6 +39,11 @@ class TrainContext:
     collective_group: str = ""
     datasets: Dict[str, List] = field(default_factory=dict)  # name->blocks
     latest_checkpoint: Optional[Checkpoint] = None
+    # When True (Tune trials), report() blocks until the controller acks
+    # the report — this makes scheduler decisions (ASHA rung stops)
+    # deterministic instead of racing trial completion. Train's gang
+    # workers keep fire-and-forget reports.
+    sync_reports: bool = False
     _report_seq: int = 0
 
     def get_world_size(self) -> int:
@@ -72,12 +83,23 @@ def get_checkpoint() -> Optional[Checkpoint]:
     return get_context().latest_checkpoint
 
 
+def _stop_requested(ctx: TrainContext) -> bool:
+    return bool(ctx.report_dir) and os.path.exists(
+        os.path.join(ctx.report_dir, "STOP"))
+
+
 def report(metrics: Dict[str, Any],
            checkpoint: Optional[Checkpoint] = None) -> None:
-    """Report metrics (and optionally a checkpoint) to the trainer."""
+    """Report metrics (and optionally a checkpoint) to the trainer.
+
+    Raises :class:`StopTrial` when the controller has placed a stop
+    token in the report channel (Tune scheduler decisions).
+    """
     ctx = get_context()
     if not ctx.report_dir:
         return  # local mode: nothing to deliver
+    if _stop_requested(ctx):
+        raise StopTrial()
     ctx._report_seq += 1
     payload: Dict[str, Any] = {"metrics": dict(metrics), "rank": ctx.rank,
                                "seq": ctx._report_seq}
@@ -91,8 +113,20 @@ def report(metrics: Dict[str, Any],
     fd, tmp = tempfile.mkstemp(dir=ctx.report_dir, suffix=".tmp")
     with os.fdopen(fd, "wb") as f:
         pickle.dump(payload, f)
-    os.rename(tmp, os.path.join(
-        ctx.report_dir, f"report_{ctx.rank:04d}_{ctx._report_seq:08d}.pkl"))
+    name = f"report_{ctx.rank:04d}_{ctx._report_seq:08d}.pkl"
+    os.rename(tmp, os.path.join(ctx.report_dir, name))
+    if ctx.sync_reports:
+        # Block until the controller acks this report (or tells us to
+        # stop). Bounded wait so a dead controller can't wedge the trial.
+        import time
+        ack = os.path.join(ctx.report_dir, name + ".ack")
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if _stop_requested(ctx):
+                raise StopTrial()
+            if os.path.exists(ack):
+                return
+            time.sleep(0.005)
 
 
 def get_dataset_shard(name: str = "train"):

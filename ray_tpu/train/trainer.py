@@ -182,7 +182,7 @@ class DataParallelTrainer:
             raise RuntimeError(
                 f"could not reserve {n} x {res} for the worker gang")
         workers = []
-        seen = 0
+        seen: set = set()
         try:
             kw: Dict[str, Any] = {}
             if "CPU" in res:
@@ -213,7 +213,6 @@ class DataParallelTrainer:
                     latest_checkpoint=latest_ckpt)
                 refs.append(w.run.remote(blob, ctx_fields, shards[i]))
 
-            seen = 0
             while True:
                 ready, not_ready = ray_tpu.wait(
                     refs, num_returns=len(refs), timeout=0.2)
@@ -241,21 +240,29 @@ class DataParallelTrainer:
             remove_placement_group(pg)
             shutil.rmtree(report_dir, ignore_errors=True)
 
-    def _drain_reports(self, report_dir: str, seen: int,
+    def _drain_reports(self, report_dir: str, seen: set,
                        history: List[Dict[str, Any]],
                        latest_ckpt: Optional[Checkpoint]):
+        # Track processed FILENAMES, not a count index: the listing is
+        # rank-major sorted, so a fresh rank-0 report sorts before
+        # already-counted rank>=1 files and a count index would skip it
+        # forever (losing rank-0 metrics/checkpoints).
         files = sorted(glob.glob(os.path.join(report_dir, "report_*.pkl")))
-        for path in files[seen:]:
+        for path in files:
+            name = os.path.basename(path)
+            if name in seen:
+                continue
             try:
                 with open(path, "rb") as f:
                     payload = pickle.load(f)
-            except (EOFError, pickle.UnpicklingError):
+            except (EOFError, pickle.UnpicklingError, FileNotFoundError):
                 continue
+            seen.add(name)
             if payload["rank"] == 0:
                 history.append(payload["metrics"])
             if "checkpoint_path" in payload and payload["rank"] == 0:
                 latest_ckpt = Checkpoint(payload["checkpoint_path"])
-        return len(files), latest_ckpt
+        return seen, latest_ckpt
 
     def _shard_datasets(self, n: int) -> List[Dict[str, List]]:
         """Split every dataset into n contiguous block lists (materialized

@@ -23,6 +23,28 @@ class TrialScheduler:
     def on_trial_result(self, trial, result: Dict) -> str:
         return CONTINUE
 
+    def on_batch_result(self, items) -> Dict[Any, str]:
+        """Decide over one controller sweep's worth of results.
+
+        ``items`` is ``[(trial, result), ...]`` in arrival order. The
+        default delegates to :meth:`on_trial_result` per item; rung-based
+        schedulers override to record ALL arrivals before deciding, so
+        concurrent trials hitting a rung in the same sweep are compared
+        against each other deterministically (sync-SHA semantics within
+        a sweep, async across sweeps).
+        Returns {trial_id: worst decision for that trial}.
+        """
+        decisions: Dict[Any, str] = {}
+        rank = {CONTINUE: 0, EXPLOIT: 1, STOP: 2}
+        for trial, result in items:
+            d = self.on_trial_result(trial, result)
+            cur = decisions.get(trial.trial_id, CONTINUE)
+            if rank[d] > rank[cur]:
+                decisions[trial.trial_id] = d
+            else:
+                decisions.setdefault(trial.trial_id, cur)
+        return decisions
+
     def on_trial_complete(self, trial, result: Optional[Dict]) -> None:
         pass
 
@@ -62,21 +84,43 @@ class ASHAScheduler(TrialScheduler):
         v = float(result[self.metric])
         return v if self.mode == "max" else -v
 
-    def on_trial_result(self, trial, result: Dict) -> str:
+    def _record(self, result: Dict) -> None:
+        t = int(result.get(self.time_attr, 0))
+        for rung in self._rungs:
+            if t == rung:
+                self._recorded[rung].append(self._score(result))
+
+    def _decide(self, result: Dict) -> str:
         t = int(result.get(self.time_attr, 0))
         if t >= self.max_t:
             return STOP
         score = self._score(result)
-        decision = CONTINUE
         for rung in self._rungs:
             if t == rung:
                 recorded = self._recorded[rung]
-                recorded.append(score)
                 k = max(1, len(recorded) // self.rf)
                 cutoff = sorted(recorded, reverse=True)[k - 1]
                 if score < cutoff:
-                    decision = STOP
-        return decision
+                    return STOP
+        return CONTINUE
+
+    def on_trial_result(self, trial, result: Dict) -> str:
+        self._record(result)
+        return self._decide(result)
+
+    def on_batch_result(self, items) -> Dict[Any, str]:
+        # Record every rung arrival in the sweep first, THEN decide:
+        # without this, whichever trial reaches a rung first sets the
+        # cutoff with its own score and sails through regardless of how
+        # weak it is.
+        for _, result in items:
+            self._record(result)
+        decisions: Dict[Any, str] = {}
+        for trial, result in items:
+            d = self._decide(result)
+            if d == STOP or trial.trial_id not in decisions:
+                decisions[trial.trial_id] = d
+        return decisions
 
 
 class MedianStoppingRule(TrialScheduler):

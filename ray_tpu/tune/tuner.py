@@ -59,7 +59,7 @@ class Trial:
         self.actor = None
         self.run_ref = None
         self.report_dir: Optional[str] = None
-        self.seen_reports = 0
+        self.seen_reports: set = set()
         self.restore_from: Optional[Checkpoint] = None
 
     @property
@@ -69,8 +69,12 @@ class Trial:
 
 @ray_tpu.remote
 class _TrialActor:
+    def ping(self):
+        return True
+
     def run(self, fn_blob: bytes, config: Dict, ctx_fields: dict):
         import cloudpickle
+        from ray_tpu.train._session import StopTrial
         ctx = TrainContext(**ctx_fields)
         ctx.config = config
         init_session(ctx)
@@ -82,6 +86,8 @@ class _TrialActor:
                 from ray_tpu.train._session import report
                 report(out)
             return True
+        except StopTrial:
+            return False  # controller-requested early stop; clean exit
         finally:
             shutdown_session()
 
@@ -226,31 +232,70 @@ class TuneController:
         running: List[Trial] = []
         exhausted = False
         while True:
-            # refill
-            while not exhausted and len(running) < self._max_concurrent:
+            # refill — two-phase so a refill batch starts CONCURRENTLY:
+            # worker spawn takes seconds per actor, and letting trial 0
+            # race ahead while trial 1's worker boots would make rung
+            # comparisons (and thus early stopping) arrival-order luck.
+            new_batch: List[Trial] = []
+            while not exhausted and \
+                    len(running) + len(new_batch) < self._max_concurrent:
                 trial = self._next_trial()
                 if trial is None:
                     exhausted = True
                     break
                 trials.append(trial)
-                self._start(trial)
-                running.append(trial)
+                self._start_actor(trial)
+                new_batch.append(trial)
+            if new_batch:
+                # Wait for the batch's workers to spawn, but keep
+                # draining/acking the already-running trials meanwhile —
+                # a blocking get here would stall their sync reports
+                # into the 30s free-run fallback.
+                pings = [t.actor.ping.remote() for t in new_batch]
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    ready, pending = ray_tpu.wait(
+                        pings, num_returns=len(pings), timeout=0.05)
+                    for t in running:
+                        self._drain(t)
+                    if not pending:
+                        break
+                for trial in new_batch:
+                    self._submit_run(trial)
+                    running.append(trial)
             if not running and exhausted:
                 break
-            # poll
+            # poll (short interval: trials block on report acks, so the
+            # controller's cadence gates trial progress)
             refs = [t.run_ref for t in running]
-            ray_tpu.wait(refs, num_returns=1, timeout=0.2)
-            still: List[Trial] = []
+            ray_tpu.wait(refs, num_returns=1, timeout=0.02)
+            # Sweep: drain ALL trials' reports first so one sweep's
+            # rung arrivals are decided against each other, not in
+            # trial order.
+            done_flags = {}
             for t in running:
                 self._drain(t)
-                decision = self._apply_scheduler(t)
-                done = self._check_done(t)
-                if decision == STOP and not done:
+                done_flags[t.trial_id] = self._check_done(t)
+            batch = []
+            for t in running:
+                for metrics in getattr(t, "_new_results", []):
+                    batch.append((t, metrics))
+                t._new_results = []
+            # Scheduler sees every result — including those drained at
+            # completion — so rung bookkeeping stays consistent.
+            decisions = (self._scheduler.on_batch_result(batch)
+                         if batch else {})
+            still: List[Trial] = []
+            for t in running:
+                decision = decisions.get(t.trial_id, CONTINUE)
+                if done_flags[t.trial_id]:
+                    self._complete(t)
+                elif decision == STOP:
                     self._stop_trial(t, "TERMINATED")
-                elif decision == EXPLOIT and not done:
+                elif decision == EXPLOIT:
                     self._exploit(t)
                     still.append(t)
-                elif not done:
+                else:
                     still.append(t)
             running = still
         return trials
@@ -263,34 +308,47 @@ class TuneController:
         self._counter += 1
         return Trial(trial_id, config)
 
-    def _start(self, trial: Trial) -> None:
+    def _start_actor(self, trial: Trial) -> None:
         kw: Dict[str, Any] = {}
         if "CPU" in self._resources:
             kw["num_cpus"] = self._resources["CPU"]
         if "TPU" in self._resources:
             kw["num_tpus"] = self._resources["TPU"]
         trial.report_dir = tempfile.mkdtemp(prefix="rtpu_trial_")
-        trial.seen_reports = 0
+        trial.seen_reports = set()
         trial.actor = _TrialActor.options(**kw).remote()
+
+    def _submit_run(self, trial: Trial) -> None:
         trial_dir = os.path.join(self._exp_dir, trial.trial_id)
         os.makedirs(trial_dir, exist_ok=True)
         ctx_fields = dict(world_size=1, rank=0,
                           trial_dir=trial_dir,
                           report_dir=trial.report_dir,
+                          sync_reports=True,
                           latest_checkpoint=trial.restore_from)
         trial.run_ref = trial.actor.run.remote(
             self._fn_blob, trial.config, ctx_fields)
         trial.status = "RUNNING"
 
+    def _start(self, trial: Trial) -> None:
+        self._start_actor(trial)
+        self._submit_run(trial)
+
     def _drain(self, trial: Trial) -> None:
+        if not trial.report_dir or not os.path.isdir(trial.report_dir):
+            return
         files = sorted(glob.glob(
             os.path.join(trial.report_dir, "report_*.pkl")))
-        for path in files[trial.seen_reports:]:
+        for path in files:
+            name = os.path.basename(path)
+            if name in trial.seen_reports:
+                continue
             try:
                 with open(path, "rb") as f:
                     payload = pickle.load(f)
-            except (EOFError, pickle.UnpicklingError):
+            except (EOFError, pickle.UnpicklingError, FileNotFoundError):
                 continue
+            trial.seen_reports.add(name)
             metrics = payload["metrics"]
             metrics.setdefault("training_iteration",
                                len(trial.results) + 1)
@@ -299,17 +357,14 @@ class TuneController:
                 trial.checkpoint = Checkpoint(payload["checkpoint_path"])
             trial._new_results = getattr(trial, "_new_results", [])
             trial._new_results.append(metrics)
-        trial.seen_reports = len(files)
-
-    def _apply_scheduler(self, trial: Trial) -> str:
-        decision = CONTINUE
-        new = getattr(trial, "_new_results", [])
-        trial._new_results = []
-        for metrics in new:
-            d = self._scheduler.on_trial_result(trial, metrics)
-            if d in (STOP, EXPLOIT):
-                decision = d
-        return decision
+            # Ack so the (sync_reports) trial may proceed past this
+            # report; written after processing so scheduler state is
+            # never behind the trial by more than one in-flight report.
+            try:
+                with open(path + ".ack", "w"):
+                    pass
+            except OSError:
+                pass
 
     def _check_done(self, trial: Trial) -> bool:
         ready, _ = ray_tpu.wait([trial.run_ref], num_returns=1, timeout=0)
@@ -322,15 +377,28 @@ class TuneController:
         except Exception as e:
             trial.status = "ERROR"
             trial.error = str(e)
+        return True
+
+    def _complete(self, trial: Trial) -> None:
         self._search.on_trial_complete(trial.trial_id, trial.last_result,
                                        error=trial.status == "ERROR")
         self._scheduler.on_trial_complete(trial, trial.last_result)
         self._cleanup_actor(trial)
-        return True
 
     def _stop_trial(self, trial: Trial, status: str) -> None:
+        # Stop token first: a trial blocked in report() raises StopTrial
+        # and unwinds cleanly before the actor is killed.
+        if trial.report_dir and os.path.isdir(trial.report_dir):
+            try:
+                with open(os.path.join(trial.report_dir, "STOP"), "w"):
+                    pass
+            except OSError:
+                pass
+        ray_tpu.wait([trial.run_ref], num_returns=1, timeout=1.0)
         trial.status = status
         self._cleanup_actor(trial)
+        self._search.on_trial_complete(trial.trial_id, trial.last_result,
+                                       error=False)
         self._scheduler.on_trial_complete(trial, trial.last_result)
 
     def _exploit(self, trial: Trial) -> None:
