@@ -99,10 +99,13 @@ _D("scheduler_top_k_absolute", int, 1,
    "Minimum top-k regardless of fraction.")
 _D("tpu_scheduler_batch_size", int, 512,
    "Pending tasks batched per TPU scheduling-kernel invocation.")
-_D("tpu_scheduler_conflict_rounds", int, 8,
-   "Bounded conflict-resolution iterations in the batched assignment kernel.")
-_D("use_tpu_scheduler", bool, False,
-   "Select the TPU policy in the ISchedulingPolicy registry.")
+_D("tpu_scheduler_min_batch", int, 64,
+   "Pending-queue depth below which the adaptive policy uses the native "
+   "CPU scan (no device round-trip floor) instead of the TPU kernel.")
+_D("use_tpu_scheduler", str, "auto",
+   "Select the TPU policy in the ISchedulingPolicy registry: "
+   "'auto' (default) uses it whenever an accelerator backend is "
+   "present, '1'/'true' forces it, '0'/'false' forces the CPU hybrid.")
 
 # --- core worker / tasks ---
 _D("task_max_retries", int, 3, "Default retries for normal tasks.")

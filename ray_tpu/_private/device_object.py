@@ -1,0 +1,105 @@
+"""HBM tier of the object plane: device-resident objects.
+
+TPU-native extension of the reference's object plane (royf/ray keeps
+every object in host shm/plasma, ``src/ray/object_manager/plasma/``
+[UNVERIFIED — mount empty, SURVEY.md §0]; GPU tensors round-trip
+through host memory unless user code sidesteps the store). Here a
+``jax.Array`` put into the object store stays where it lives — HBM —
+and is served zero-copy to same-process consumers. A host copy is
+materialized ONLY when demanded:
+
+- a consumer in another process needs the bytes (spill-to-shm on
+  dispatch), or
+- the owner explicitly spills under memory pressure.
+
+The device copy remains primary; host copies are a cache. Reference
+counting frees the HBM buffer exactly like any other object entry.
+
+Sharded arrays (``jax.Array`` over a ``Mesh``) are first-class: the
+store holds the array object, so shardings, committed devices, and
+donation state survive put/get round trips untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+
+def is_device_value(value) -> bool:
+    """True for values that should take the HBM tier (a ``jax.Array``,
+    including sharded ones). Never imports jax: if jax isn't loaded,
+    the value can't be a jax array."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    try:
+        return isinstance(value, jax.Array)
+    except Exception:  # pragma: no cover - exotic jax builds
+        return False
+
+
+class DeviceStore:
+    """Owner-side map of ObjectID -> device-resident ``jax.Array``.
+
+    Holding the array object pins its HBM buffers (jax arrays are
+    immutable; liveness == referenceability). ``free`` drops the
+    reference and lets the runtime reclaim HBM.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._arrays: Dict[ObjectID, object] = {}
+        self.num_put = 0
+        self.num_spilled_to_host = 0
+
+    def put(self, object_id: ObjectID, array) -> None:
+        with self._lock:
+            if object_id in self._arrays:
+                raise ValueError(f"device object {object_id} already exists")
+            self._arrays[object_id] = array
+            self.num_put += 1
+
+    def get(self, object_id: ObjectID):
+        with self._lock:
+            return self._arrays.get(object_id)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._arrays
+
+    def free(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._arrays.pop(object_id, None)
+
+    def nbytes(self, object_id: ObjectID) -> Optional[int]:
+        with self._lock:
+            arr = self._arrays.get(object_id)
+        if arr is None:
+            return None
+        try:
+            return int(arr.nbytes)
+        except Exception:
+            return None
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._arrays.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = 0
+            for arr in self._arrays.values():
+                try:
+                    total += int(arr.nbytes)
+                except Exception:
+                    pass
+            return {
+                "num_objects": len(self._arrays),
+                "hbm_bytes": total,
+                "num_put": self.num_put,
+                "num_spilled_to_host": self.num_spilled_to_host,
+            }

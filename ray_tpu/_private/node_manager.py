@@ -128,6 +128,8 @@ class NodeManagerGroup:
         self.dependency_manager = DependencyManager()
         self.pg_manager = None  # set by the owning Worker after init
         self._fail_task_cb = None  # (spec, exception) -> None; set by Worker
+        self._recover_object_cb = None  # (ObjectID) -> bool; set by Worker
+        self._ensure_host_copy_cb = None  # (ObjectID) -> (name, size)|None
 
         self._lock = threading.RLock()
         self._raylets: Dict[NodeID, Raylet] = {}
@@ -431,6 +433,19 @@ class NodeManagerGroup:
                     # Upstream task failed: propagate its error verbatim,
                     # never retry the dependent (reference semantics).
                     self._complete_task(spec.task_id, [], err.entry.data, None)
+                elif isinstance(err, _LostArgError):
+                    # An argument's backing storage vanished: recover it
+                    # from lineage and requeue this task behind it.
+                    recovered = (self._recover_object_cb(err.object_id)
+                                 if self._recover_object_cb else False)
+                    if recovered:
+                        self.submit_task(spec)
+                    elif self._fail_task_cb is not None:
+                        from ray_tpu.exceptions import ObjectLostError
+                        self._fail_task_cb(spec, ObjectLostError(
+                            f"argument {err.object_id} of "
+                            f"{spec.repr_name()} was lost and cannot be "
+                            "reconstructed"))
                 else:
                     self._complete_task(spec.task_id, [], None, err)
 
@@ -443,7 +458,14 @@ class NodeManagerGroup:
             if arg.object_id is None:
                 arg_descs.append(("v", arg.inline_blob))
                 continue
-            entry = self._memory_store.get(arg.object_id, timeout=0)
+            try:
+                entry = self._memory_store.get(arg.object_id, timeout=0)
+            except TimeoutError:
+                # Directory entry purged by a concurrent lineage
+                # reconstruction between the dependency check and here.
+                with self._lock:
+                    self._running.pop(spec.task_id, None)
+                return _LostArgError(arg.object_id)
             if entry.kind == "err":
                 # dependency failed -> propagate without executing
                 with self._lock:
@@ -451,7 +473,22 @@ class NodeManagerGroup:
                 return _DependencyError(entry)
             if entry.kind == "blob":
                 arg_descs.append(("v", entry.data))
+            elif entry.kind == "device":
+                # HBM-resident object crossing a process boundary:
+                # materialize a host copy on demand.
+                info = (self._ensure_host_copy_cb(arg.object_id)
+                        if self._ensure_host_copy_cb else None)
+                if info is None:
+                    with self._lock:
+                        self._running.pop(spec.task_id, None)
+                    return _LostArgError(arg.object_id)
+                arg_descs.append(("shm", arg.object_id.binary(),
+                                  info[0], info[1]))
             else:  # shm
+                if not self._shm_store.contains(arg.object_id):
+                    with self._lock:
+                        self._running.pop(spec.task_id, None)
+                    return _LostArgError(arg.object_id)
                 name, size = entry.data
                 arg_descs.append(("shm", arg.object_id.binary(), name, size))
         payload = {
@@ -644,3 +681,11 @@ class _DependencyError(Exception):
     def __init__(self, entry):
         self.entry = entry
         super().__init__("dependency failed")
+
+
+class _LostArgError(Exception):
+    """Internal: an argument object's backing storage is gone."""
+
+    def __init__(self, object_id):
+        self.object_id = object_id
+        super().__init__("argument object lost")

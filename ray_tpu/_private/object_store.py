@@ -196,7 +196,9 @@ class ShmStore:
         if info is None:
             return None
         with self._lock:
-            seg = self._segments[object_id]
+            seg = self._segments.get(object_id)
+            if seg is None:       # freed/re-lost between calls
+                return None
             return seg.buf[:self._sizes[object_id]]
 
     # -- lifetime ----------------------------------------------------------
@@ -274,10 +276,16 @@ class ShmStore:
                 return
             path, size = entry
             self._ensure_capacity(size)
+            try:
+                f = open(path, "rb")
+            except FileNotFoundError:
+                # Spill file lost: the object is gone; the owner's
+                # lineage reconstruction path takes it from here.
+                return
             seg = shared_memory.SharedMemory(
                 name=_segment_name(self._session, object_id),
                 create=True, size=max(size, 1), **_TRACK_KW)
-            with open(path, "rb") as f:
+            with f:
                 f.readinto(seg.buf[:size])
             os.unlink(path)
             self._segments[object_id] = seg

@@ -39,39 +39,65 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
         self._lib = scheduler_lib()
         if self._lib is None:
             raise ImportError("native scheduler library failed to build")
-        # matrix cache keyed by cluster version
+        # Dense-matrix cache maintained incrementally from the cluster's
+        # mutation log: only rows whose nodes changed since the cached
+        # version are rewritten, so steady-state per-batch overhead is
+        # O(dirty nodes), not O(cluster).
         self._cached_version = -1
         self._node_order: List[NodeID] = []
+        self._node_index: Dict[NodeID, int] = {}
         self._res_names: List[str] = []
         self._total: Optional[np.ndarray] = None
         self._alive: Optional[np.ndarray] = None
+        self._avail: Optional[np.ndarray] = None
 
-    def _matrices(self, cluster: ClusterResourceManager):
-        version = cluster.version()
+    def _write_row(self, i: int, node) -> None:
+        self._alive[i] = 1 if node.alive else 0
+        for j, name in enumerate(self._res_names):
+            self._total[i, j] = node.total.get(name, 0.0)
+            self._avail[i, j] = node.available.get(name, 0.0)
+
+    def _rebuild(self, cluster: ClusterResourceManager, version: int):
         snap = cluster.snapshot()
-        if version != self._cached_version or self._total is None:
-            names = sorted({k for node in snap.values()
-                            for k in node.total})
-            self._res_names = names
-            self._node_order = list(snap.keys())
-            n, r = len(self._node_order), max(len(names), 1)
-            self._total = np.zeros((n, r), np.float32)
-            self._alive = np.zeros(n, np.uint8)
-            for i, nid in enumerate(self._node_order):
-                node = snap[nid]
-                self._alive[i] = 1 if node.alive else 0
-                for j, name in enumerate(names):
-                    self._total[i, j] = node.total.get(name, 0.0)
-            self._cached_version = version
-        n, r = len(self._node_order), max(len(self._res_names), 1)
-        avail = np.zeros((n, r), np.float32)
+        names = sorted({k for node in snap.values() for k in node.total})
+        self._res_names = names
+        self._node_order = list(snap.keys())
+        self._node_index = {nid: i for i, nid in enumerate(self._node_order)}
+        n, r = len(self._node_order), max(len(names), 1)
+        self._total = np.zeros((n, r), np.float32)
+        self._alive = np.zeros(n, np.uint8)
+        self._avail = np.zeros((n, r), np.float32)
         for i, nid in enumerate(self._node_order):
-            node = snap.get(nid)
-            if node is None:
-                continue
-            for j, name in enumerate(self._res_names):
-                avail[i, j] = node.available.get(name, 0.0)
-        return avail
+            self._write_row(i, snap[nid])
+        self._cached_version = version
+
+    def _matrices(self, cluster: ClusterResourceManager) -> np.ndarray:
+        """Sync the cached matrices to the cluster; returns a private
+        copy of avail (the native batch loop mutates it)."""
+        version = cluster.version()
+        if self._avail is None:
+            self._rebuild(cluster, version)
+        elif version != self._cached_version:
+            changes = cluster.changes_since(self._cached_version)
+            if changes is None or changes[1]:
+                # log outran or membership changed: full rebuild
+                self._rebuild(cluster, version)
+            else:
+                for nid in changes[0]:
+                    node = cluster.get_node(nid)
+                    i = self._node_index.get(nid)
+                    if node is None or i is None:
+                        self._rebuild(cluster, version)
+                        break
+                    new_res = {k for k in node.total
+                               if k not in self._res_names}
+                    if new_res:
+                        self._rebuild(cluster, version)
+                        break
+                    self._write_row(i, node)
+                else:
+                    self._cached_version = version
+        return self._avail.copy()
 
     def schedule_batch(self, cluster: ClusterResourceManager,
                        requests: Sequence[SchedulingRequest]
@@ -79,7 +105,7 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
         import ctypes as ct
         avail = self._matrices(cluster)
         n_nodes, n_res = avail.shape
-        node_index = {nid: i for i, nid in enumerate(self._node_order)}
+        node_index = self._node_index
         # Requests naming a resource no node has are infeasible outright.
         # They must NOT reach the native loop: a partial demand row would
         # be allocated from the shared batch-availability view, spuriously

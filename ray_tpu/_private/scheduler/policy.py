@@ -303,17 +303,51 @@ def _cpu_hybrid_policy() -> ISchedulingPolicy:
         return create_policy("hybrid")
 
 
+_accelerator_cache: Optional[bool] = None
+
+
+def _accelerator_present() -> bool:
+    """True iff jax's default backend is a real accelerator (TPU/GPU).
+
+    Cached: backend detection initializes jax, which is expensive and
+    stable for the process lifetime.
+    """
+    global _accelerator_cache
+    if _accelerator_cache is None:
+        try:
+            import jax
+            _accelerator_cache = jax.default_backend() not in ("cpu",)
+        except Exception:
+            _accelerator_cache = False
+    return _accelerator_cache
+
+
+def _tpu_scheduler_enabled() -> bool:
+    """Resolve the three-state ``use_tpu_scheduler`` knob.
+
+    The TPU kernel is the production scheduling path whenever an
+    accelerator is attached (the north star demands the TPU path be the
+    default on TPU hosts, BASELINE.json:5); on CPU-only hosts a device
+    round-trip per scheduling batch would cost more than the native
+    hybrid scan, so 'auto' falls back.
+    """
+    val = get_config().use_tpu_scheduler
+    v = str(val).strip().lower()
+    if v in ("auto", ""):
+        return _accelerator_present()
+    return v in ("1", "true", "yes", "on")
+
+
 def default_policy() -> ISchedulingPolicy:
-    cfg = get_config()
     inner: ISchedulingPolicy
-    if cfg.use_tpu_scheduler:
+    if _tpu_scheduler_enabled():
         try:
             from ray_tpu._private.scheduler import tpu_policy  # noqa: F401
-            inner = create_policy("tpu")
+            inner = create_policy("tpu_adaptive")
         except (ImportError, ValueError) as e:
             import logging
             logging.getLogger(__name__).warning(
-                "use_tpu_scheduler=1 but the TPU policy is unavailable "
+                "TPU scheduling policy selected but unavailable "
                 "(%s); falling back to hybrid", e)
             inner = _cpu_hybrid_policy()
     else:

@@ -9,8 +9,9 @@ the local view is authoritative for the node's own dispatch.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, Iterator, Optional, Tuple
+from typing import Dict, Iterator, Optional, Set, Tuple
 
 from ray_tpu._private.ids import NodeID
 
@@ -73,22 +74,30 @@ class ClusterResourceManager:
     write it.
     """
 
+    _LOG_CAP = 4096
+
     def __init__(self):
         self._nodes: Dict[NodeID, NodeResources] = {}
         self._lock = threading.RLock()
         self._version = 0  # bumped on every mutation; lets the TPU policy
         #                    invalidate its device-resident resource matrix.
+        # Bounded mutation log: (version, node_id, membership_change).
+        # Policies use it to update their dense matrices row-wise instead
+        # of rebuilding O(nodes) state per scheduling batch.
+        self._log: deque = deque(maxlen=self._LOG_CAP)
 
     def add_or_update_node(self, node_id: NodeID,
                            resources: NodeResources) -> None:
         with self._lock:
             self._nodes[node_id] = resources
             self._version += 1
+            self._log.append((self._version, node_id, True))
 
     def remove_node(self, node_id: NodeID) -> None:
         with self._lock:
             self._nodes.pop(node_id, None)
             self._version += 1
+            self._log.append((self._version, node_id, True))
 
     def get_node(self, node_id: NodeID) -> Optional[NodeResources]:
         with self._lock:
@@ -114,6 +123,7 @@ class ClusterResourceManager:
             ok = node.allocate(demand)
             if ok:
                 self._version += 1
+                self._log.append((self._version, node_id, False))
             return ok
 
     def free(self, node_id: NodeID, demand: ResourceRequest) -> None:
@@ -122,6 +132,24 @@ class ClusterResourceManager:
             if node is not None:
                 node.free(demand)
                 self._version += 1
+                self._log.append((self._version, node_id, False))
+
+    def changes_since(self, version: int
+                      ) -> Optional[Tuple[Set[NodeID], bool]]:
+        """(dirty_nodes, membership_changed) covering (version, now], or
+        None when the gap outran the bounded log (caller must rebuild)."""
+        with self._lock:
+            if version == self._version:
+                return set(), False
+            if not self._log or self._log[0][0] > version + 1:
+                return None
+            dirty: Set[NodeID] = set()
+            membership = False
+            for v, nid, member in self._log:
+                if v > version:
+                    dirty.add(nid)
+                    membership = membership or member
+            return dirty, membership
 
     def snapshot(self) -> Dict[NodeID, NodeResources]:
         with self._lock:

@@ -26,6 +26,10 @@ class TaskRecord:
     status: str = "pending"          # pending|running|finished|failed
     attempt: int = 0
     error: Optional[str] = None
+    # Lineage re-executions remaining (reference bounds object
+    # reconstruction by the task's max_retries, independent of the
+    # failure-retry budget: ``object_recovery_manager.cc``).
+    reconstructions_left: int = 0
 
 
 class Entry:
@@ -62,13 +66,15 @@ class TaskManager:
         self.num_finished = 0
         self.num_failed = 0
         self.num_retries = 0
+        self.num_reconstructions = 0
 
     # -- submission --------------------------------------------------------
 
     def add_pending_task(self, spec: TaskSpec) -> None:
         with self._lock:
             self._tasks[spec.task_id] = TaskRecord(
-                spec=spec, retries_left=spec.max_retries)
+                spec=spec, retries_left=spec.max_retries,
+                reconstructions_left=spec.max_retries)
             for oid in spec.return_ids:
                 self._lineage[oid] = spec.task_id
 
@@ -158,6 +164,34 @@ class TaskManager:
                 return None
             rec = self._tasks.get(tid)
             return rec.spec if rec else None
+
+    def prepare_reconstruction(self, object_id: ObjectID
+                               ) -> Tuple[Optional[TaskSpec], bool]:
+        """Transition the creating task back to pending for lineage
+        re-execution of a lost object.
+
+        Returns ``(spec, needs_resubmit)``: ``(None, False)`` when
+        recovery is impossible (no lineage retained or reconstruction
+        budget exhausted); ``(spec, False)`` when the task is already
+        pending/running (recovery piggybacks on the in-flight
+        execution, no budget consumed); ``(spec, True)`` when the
+        caller must resubmit the spec."""
+        with self._lock:
+            tid = self._lineage.get(object_id)
+            if tid is None:
+                return None, False
+            rec = self._tasks.get(tid)
+            if rec is None:
+                return None, False
+            if rec.status in ("pending", "running"):
+                return rec.spec, False   # already being (re)computed
+            if rec.reconstructions_left <= 0:
+                return None, False
+            rec.reconstructions_left -= 1
+            rec.attempt += 1
+            rec.status = "pending"
+            self.num_reconstructions += 1
+            return rec.spec, True
 
     def release_lineage(self, object_id: ObjectID) -> None:
         with self._lock:

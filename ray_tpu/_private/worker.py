@@ -47,10 +47,17 @@ from ray_tpu._private.task_spec import (
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
+    ObjectLostError,
+    ObjectReconstructionFailedError,
     TaskError,
 )
 
 logger = logging.getLogger(__name__)
+
+
+class _LostObjectSignal(Exception):
+    """Internal: a sealed object's backing storage is gone; the caller
+    should attempt lineage reconstruction."""
 
 
 def _detect_num_tpus() -> int:
@@ -88,6 +95,8 @@ class Worker:
             self.session,
             object_store_memory or cfg.object_store_memory_bytes,
             spill_threshold=cfg.object_spilling_threshold)
+        from ray_tpu._private.device_object import DeviceStore
+        self.device_store = DeviceStore()
         self.reference_counter = ReferenceCounter(self._on_ref_zero)
         self.gcs = GcsLite()
 
@@ -132,6 +141,8 @@ class Worker:
             on_created=self._on_pg_created)
         self.node_group.pg_manager = self.pg_manager
         self.node_group._fail_task_cb = self._fail_task
+        self.node_group._recover_object_cb = self._recover_object
+        self.node_group._ensure_host_copy_cb = self._ensure_host_copy
         self._pg_ready_refs: Dict[Any, ObjectID] = {}
         self.gcs.register_node(NodeInfo(
             node_id=self.node_group.head_node_id,
@@ -193,6 +204,15 @@ class Worker:
 
     def _put_value(self, oid: ObjectID, value: Any) -> None:
         cfg = get_config()
+        from ray_tpu._private.device_object import is_device_value
+        if is_device_value(value):
+            # HBM tier: the array stays device-resident (sharding and
+            # all); same-process consumers get it back zero-copy. A
+            # host copy is materialized only when another process needs
+            # the bytes (_ensure_host_copy).
+            self.device_store.put(oid, value)
+            self._store_result(oid, Entry("device", None))
+            return
         ser = self.serde.serialize(value)
         contained = tuple(ser.contained_refs)
         size = ser.size_with_header()
@@ -225,6 +245,7 @@ class Worker:
     def _on_ref_zero(self, oid: ObjectID) -> None:
         self.memory_store.free(oid)
         self.shm_store.free(oid)
+        self.device_store.free(oid)
         self.task_manager.release_lineage(oid)
 
     def get(self, refs: Sequence[ObjectRef],
@@ -232,15 +253,28 @@ class Worker:
         deadline = None if timeout is None else time.monotonic() + timeout
         out: List[Any] = []
         for ref in refs:
-            remaining = None
-            if deadline is not None:
-                remaining = max(0.0, deadline - time.monotonic())
-            try:
-                entry: Entry = self.memory_store.get(ref.id(), remaining)
-            except TimeoutError:
-                raise GetTimeoutError(
-                    f"get() timed out waiting for {ref}") from None
-            out.append(self._entry_value(ref.id(), entry))
+            while True:
+                remaining = None
+                if deadline is not None:
+                    remaining = max(0.0, deadline - time.monotonic())
+                try:
+                    entry: Entry = self.memory_store.get(ref.id(), remaining)
+                except TimeoutError:
+                    raise GetTimeoutError(
+                        f"get() timed out waiting for {ref}") from None
+                try:
+                    out.append(self._entry_value(ref.id(), entry))
+                    break
+                except _LostObjectSignal:
+                    # Backing storage vanished under the directory
+                    # entry: re-execute the creating task from lineage
+                    # (reference: object_recovery_manager.cc) and wait
+                    # for the fresh copy.
+                    if not self._recover_object(ref.id()):
+                        raise ObjectLostError(
+                            f"object {ref.id()} was lost and cannot be "
+                            "reconstructed (no lineage retained or "
+                            "reconstruction budget exhausted)") from None
         return out
 
     def _entry_value(self, oid: ObjectID, entry: Entry) -> Any:
@@ -257,13 +291,88 @@ class Worker:
                 else err
         if entry.kind == "blob":
             value, _ = self.serde.deserialize_from_blob(memoryview(entry.data))
+        elif entry.kind == "device":
+            value = self.device_store.get(oid)
+            if value is None:
+                raise _LostObjectSignal(oid)
         else:  # shm
             blob = self.shm_store.get_local(oid)
             if blob is None:
-                raise GetTimeoutError(f"object {oid} no longer in store")
+                raise _LostObjectSignal(oid)
             value, _ = self.serde.deserialize_from_blob(blob)
         entry.cache_value(value)
         return value
+
+    def _ensure_host_copy(self, oid: ObjectID) -> Optional[tuple]:
+        """(segment_name, size) of a host copy of a device object,
+        materializing it (device->host gather + shm write) on first
+        demand. The HBM copy stays primary. None if the object is gone.
+        """
+        info = self.shm_store.segment_for(oid)
+        if info is not None:
+            return info
+        arr = self.device_store.get(oid)
+        if arr is None:
+            return None
+        ser = self.serde.serialize(arr)
+        size = ser.size_with_header()
+        try:
+            buf = self.shm_store.create(oid, size)
+        except ValueError:      # raced: another thread spilled it
+            return self.shm_store.segment_for(oid)
+        ser.write_into(buf)
+        self.shm_store.seal(oid)
+        self.device_store.num_spilled_to_host += 1
+        return self.shm_store.segment_for(oid)
+
+    # -- lineage reconstruction ----------------------------------------
+
+    def _object_live(self, oid: ObjectID) -> bool:
+        """Directory entry present AND its backing storage intact."""
+        try:
+            entry: Entry = self.memory_store.get(oid, timeout=0)
+        except TimeoutError:   # freed/purged concurrently
+            return False
+        if entry.kind == "shm":
+            return self.shm_store.contains(oid)
+        return True
+
+    def _recover_object(self, oid: ObjectID) -> bool:
+        """Lineage reconstruction (reference:
+        ``src/ray/core_worker/object_recovery_manager.cc``): re-submit
+        the task that created ``oid``, recursively recovering lost
+        arguments first. Bounded per task by ``max_retries``. Returns
+        True when a recovery (or the original execution) is in flight —
+        the caller waits on the store — and False when the object is
+        unrecoverable."""
+        spec = self.task_manager.lineage_task_for(oid)
+        if spec is None or spec.task_type != TaskType.NORMAL_TASK:
+            return False
+        spec, needs_resubmit = self.task_manager.prepare_reconstruction(oid)
+        if spec is None:
+            return False
+        if not needs_resubmit:
+            return True       # already being recomputed; piggyback
+        logger.info("reconstructing %s for lost object %s",
+                    spec.repr_name(), oid)
+        # Purge the stale directory entries so consumers block until
+        # the re-execution lands. (The old entries' contained-ref
+        # counts are left in place: the fresh result re-registers them,
+        # which can over-pin contained objects — safe direction.)
+        for roid in spec.return_ids:
+            self.memory_store.free(roid)
+            self.shm_store.free(roid)
+        for dep in spec.dependencies():
+            if not self._object_live(dep) and not self._recover_object(dep):
+                err = ObjectReconstructionFailedError(
+                    f"cannot reconstruct {oid}: argument {dep} of "
+                    f"{spec.repr_name()} was lost and is itself "
+                    "unrecoverable")
+                for roid in spec.return_ids:
+                    self._store_error(roid, err)
+                return True   # an (error) result is now available
+        self.node_group.submit_task(spec)
+        return True
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None
@@ -581,7 +690,20 @@ class Worker:
                     if not all(self.memory_store.contains(d) for d in deps):
                         return
                     queue.popleft()
-                payload, dep_err = self._build_actor_payload(spec)
+                try:
+                    payload, dep_err = self._build_actor_payload(spec)
+                except _LostObjectSignal as sig:
+                    lost_oid = sig.args[0]
+                    if self._recover_object(lost_oid):
+                        # requeue behind the reconstruction; the purged
+                        # entry keeps the dependency check unsatisfied
+                        with self._actor_lock:
+                            self._actor_queues[actor_id].appendleft(spec)
+                        return
+                    self._fail_task(spec, ObjectLostError(
+                        f"argument {lost_oid} of {spec.repr_name()} was "
+                        "lost and cannot be reconstructed"))
+                    continue
                 if dep_err is not None:
                     self.task_manager.complete_task(spec.task_id, [],
                                                     dep_err, None)
@@ -600,12 +722,25 @@ class Worker:
             if arg.object_id is None:
                 arg_descs.append(("v", arg.inline_blob))
                 continue
-            entry: Entry = self.memory_store.get(arg.object_id, timeout=0)
+            try:
+                entry: Entry = self.memory_store.get(arg.object_id, timeout=0)
+            except TimeoutError:
+                # Purged by a concurrent reconstruction: route through
+                # the lost-object recovery path.
+                raise _LostObjectSignal(arg.object_id) from None
             if entry.kind == "err":
                 return None, entry.data
             if entry.kind == "blob":
                 arg_descs.append(("v", entry.data))
+            elif entry.kind == "device":
+                info = self._ensure_host_copy(arg.object_id)
+                if info is None:
+                    raise _LostObjectSignal(arg.object_id)
+                arg_descs.append(
+                    ("shm", arg.object_id.binary(), info[0], info[1]))
             else:
+                if not self.shm_store.contains(arg.object_id):
+                    raise _LostObjectSignal(arg.object_id)
                 name, size = entry.data
                 arg_descs.append(
                     ("shm", arg.object_id.binary(), name, size))
@@ -673,6 +808,7 @@ class Worker:
         self.reference_counter.freeze()
         self.node_group.shutdown()
         self.shm_store.shutdown()
+        self.device_store.shutdown()
 
     def cluster_resources(self) -> Dict[str, float]:
         total: Dict[str, float] = {}
