@@ -133,6 +133,9 @@ _D("worker_pool_max_idle_s", float, 60.0, "Idle worker reap time.")
 _D("worker_start_timeout_s", float, 60.0, "Worker process start timeout.")
 
 # --- gcs / health ---
+_D("gcs_mode", str, "inproc",
+   "'inproc' hosts the GCS tables in the driver; 'process' spawns a "
+   "standalone GCS server process and talks to it over the wire.")
 _D("health_check_period_ms", int, 1000, "GCS -> node health ping period.")
 _D("health_check_failure_threshold", int, 5,
    "Missed pings before a node is declared dead.")

@@ -1,0 +1,115 @@
+"""GCS client: the GcsLite surface over the wire.
+
+Reference: ``src/ray/gcs/gcs_client/`` accessors [UNVERIFIED — mount
+empty, SURVEY.md §0]. Drop-in for ``GcsLite`` (same method surface, so
+``Worker`` and libraries cannot tell which they hold) plus a local
+``publisher`` fed by server push — subscriptions made on either side
+see the same channel stream.
+
+Actor-info reads are cached: task submission consults actor state per
+call, and a wire round-trip there would put the GCS on the task hot
+path (the reference keeps GCS off it). Pushes on the ACTOR channel
+invalidate the cache.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.gcs import ActorInfo, NodeInfo, Publisher
+from ray_tpu._private.ids import ActorID, NodeID
+from ray_tpu._private.rpc import RpcClient
+
+logger = logging.getLogger(__name__)
+
+
+class GcsClient:
+    def __init__(self, address: Tuple[str, int]):
+        self.address = tuple(address)
+        self.publisher = Publisher()
+        self._actor_cache: Dict[ActorID, ActorInfo] = {}
+        self._cache_lock = threading.Lock()
+        self._client = RpcClient(self.address, on_push=self._on_push)
+        for channel in ("NODE", "ACTOR", "RESOURCES"):
+            self._client.call("subscribe", channel)
+
+    def _on_push(self, topic: str, message) -> None:
+        if topic == "ACTOR":
+            # (state, actor_id): drop the cached info; next read refetches.
+            try:
+                with self._cache_lock:
+                    self._actor_cache.pop(message[1], None)
+            except Exception:
+                pass
+        self.publisher.publish(topic, message)
+
+    # -- jobs ----------------------------------------------------------
+
+    def next_job_id(self) -> int:
+        return self._client.call("next_job_id")
+
+    # -- nodes ---------------------------------------------------------
+
+    def register_node(self, info: NodeInfo,
+                      rpc_addr: Optional[Tuple[str, int]] = None) -> None:
+        self._client.call("register_node", info, rpc_addr)
+
+    def remove_node(self, node_id: NodeID) -> None:
+        self._client.call("remove_node", node_id)
+
+    def get_all_node_info(self) -> List[NodeInfo]:
+        return self._client.call("get_all_node_info")
+
+    def report_resources(self, node_id: NodeID,
+                         available: Dict[str, float]) -> None:
+        self._client.oneway("report_resources", node_id, available)
+
+    # -- actors --------------------------------------------------------
+
+    def register_actor(self, info: ActorInfo) -> None:
+        self._client.call("register_actor", info)
+        with self._cache_lock:
+            self._actor_cache[info.actor_id] = info
+
+    def update_actor_state(self, actor_id: ActorID, state: str,
+                           death_cause: str = "") -> None:
+        self._client.call("update_actor_state", actor_id, state, death_cause)
+        with self._cache_lock:
+            self._actor_cache.pop(actor_id, None)
+
+    def get_actor_info(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        with self._cache_lock:
+            info = self._actor_cache.get(actor_id)
+        if info is not None:
+            return info
+        info = self._client.call("get_actor_info", actor_id)
+        if info is not None:
+            with self._cache_lock:
+                self._actor_cache[actor_id] = info
+        return info
+
+    def get_named_actor(self, name: str, namespace: str
+                        ) -> Optional[ActorInfo]:
+        return self._client.call("get_named_actor", name, namespace)
+
+    def list_actors(self) -> List[ActorInfo]:
+        return self._client.call("list_actors")
+
+    # -- internal KV ---------------------------------------------------
+
+    def kv_put(self, key: bytes, value: bytes, namespace: str = "") -> None:
+        self._client.call("kv_put", key, value, namespace)
+
+    def kv_get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
+        return self._client.call("kv_get", key, namespace)
+
+    def kv_del(self, key: bytes, namespace: str = "") -> None:
+        self._client.call("kv_del", key, namespace)
+
+    def kv_keys(self, prefix: bytes, namespace: str = "") -> List[bytes]:
+        return self._client.call("kv_keys", prefix, namespace)
+
+    def close(self) -> None:
+        self._client.close()

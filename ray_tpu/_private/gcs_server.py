@@ -1,0 +1,235 @@
+"""Standalone GCS server process.
+
+Reference: ``src/ray/gcs/gcs_server/`` — GcsServer hosting node/actor/
+KV managers, GcsPublisher, and GcsHealthCheckManager [UNVERIFIED —
+mount empty, SURVEY.md §0]. This process wraps the same ``GcsLite``
+tables behind the wire RPC layer (``rpc.py``) and adds the two things
+an in-process GCS cannot have: subscribers in OTHER processes (push
+channels) and liveness authority (periodic health pings to every
+registered raylet; a node missing ``health_check_failure_threshold``
+consecutive pings is declared dead and its removal is published).
+
+Run as a process via ``spawn_gcs_process`` (port handshake through a
+file) or embedded via ``GcsServer`` (tests).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.gcs import GcsLite, NodeInfo
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.rpc import ConnectionContext, RpcClient, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class GcsServer:
+    """RPC surface + health manager around GcsLite."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.state = GcsLite()
+        self._subs_lock = threading.Lock()
+        # channel -> list of subscriber connections
+        self._subscribers: Dict[str, List[ConnectionContext]] = {}
+        # node_id -> (rpc address, consecutive failures)
+        self._health_lock = threading.Lock()
+        self._node_addrs: Dict[NodeID, Tuple[str, int]] = {}
+        self._health_fails: Dict[NodeID, int] = {}
+        self._shutdown = threading.Event()
+
+        self.server = RpcServer(host, port)
+        self.address = self.server.address
+        s = self.server
+        s.register("ping", lambda ctx: "pong")
+        s.register("register_node", self._register_node)
+        s.register("remove_node", self._remove_node)
+        s.register("get_all_node_info", lambda ctx: self.state.get_all_node_info())
+        s.register("register_actor", lambda ctx, info: self._register_actor(info))
+        s.register("update_actor_state",
+                   lambda ctx, aid, st, cause: self._update_actor_state(
+                       aid, st, cause))
+        s.register("get_actor_info",
+                   lambda ctx, aid: self.state.get_actor_info(aid))
+        s.register("get_named_actor",
+                   lambda ctx, name, ns: self.state.get_named_actor(name, ns))
+        s.register("list_actors", lambda ctx: self.state.list_actors())
+        s.register("kv_put", lambda ctx, k, v, ns: self.state.kv_put(k, v, ns))
+        s.register("kv_get", lambda ctx, k, ns: self.state.kv_get(k, ns))
+        s.register("kv_del", lambda ctx, k, ns: self.state.kv_del(k, ns))
+        s.register("kv_keys",
+                   lambda ctx, p, ns: self.state.kv_keys(p, ns))
+        s.register("next_job_id", lambda ctx: self.state.next_job_id())
+        s.register("subscribe", self._subscribe)
+        s.register("report_resources", self._report_resources)
+        self.server.on_disconnect(self._on_disconnect)
+
+        # Local publications (from handler threads) also fan out to wire
+        # subscribers.
+        self.state.publisher.subscribe("NODE",
+                                       lambda m: self._publish("NODE", m))
+        self.state.publisher.subscribe("ACTOR",
+                                       lambda m: self._publish("ACTOR", m))
+
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="rtpu-gcs-health")
+        self._health_thread.start()
+
+    # -- handlers ------------------------------------------------------
+
+    def _register_node(self, ctx: ConnectionContext, info: NodeInfo,
+                       rpc_addr: Optional[Tuple[str, int]]) -> None:
+        self.state.register_node(info)
+        if rpc_addr is not None:
+            with self._health_lock:
+                self._node_addrs[info.node_id] = tuple(rpc_addr)
+                self._health_fails[info.node_id] = 0
+
+    def _remove_node(self, ctx: ConnectionContext, node_id: NodeID) -> None:
+        with self._health_lock:
+            self._node_addrs.pop(node_id, None)
+            self._health_fails.pop(node_id, None)
+        self.state.remove_node(node_id)
+
+    def _register_actor(self, info) -> None:
+        self.state.register_actor(info)
+
+    def _update_actor_state(self, actor_id, state, cause) -> None:
+        self.state.update_actor_state(actor_id, state, cause)
+
+    def _report_resources(self, ctx: ConnectionContext, node_id: NodeID,
+                          available: Dict[str, float]) -> None:
+        """Raylet resource report (reference: ray_syncer broadcast);
+        relayed to RESOURCES subscribers (the scheduler's view)."""
+        self._publish("RESOURCES", (node_id, available))
+
+    def _subscribe(self, ctx: ConnectionContext, channel: str) -> None:
+        with self._subs_lock:
+            self._subscribers.setdefault(channel, []).append(ctx)
+
+    def _on_disconnect(self, ctx: ConnectionContext) -> None:
+        with self._subs_lock:
+            for subs in self._subscribers.values():
+                if ctx in subs:
+                    subs.remove(ctx)
+
+    def _publish(self, channel: str, message) -> None:
+        with self._subs_lock:
+            subs = list(self._subscribers.get(channel, ()))
+        for ctx in subs:
+            ctx.push(channel, message)
+
+    # -- health manager ------------------------------------------------
+
+    def _health_loop(self) -> None:
+        cfg = get_config()
+        period = cfg.health_check_period_ms / 1000.0
+        threshold = cfg.health_check_failure_threshold
+        clients: Dict[NodeID, RpcClient] = {}
+        while not self._shutdown.wait(period):
+            with self._health_lock:
+                targets = dict(self._node_addrs)
+            for node_id, addr in targets.items():
+                ok = False
+                try:
+                    client = clients.get(node_id)
+                    if client is None or not client.alive:
+                        client = RpcClient(addr, connect_timeout=period)
+                        clients[node_id] = client
+                    client.call("ping", timeout=period * 2)
+                    ok = True
+                except Exception:
+                    ok = False
+                declare_dead = False
+                with self._health_lock:
+                    if node_id not in self._node_addrs:
+                        continue
+                    if ok:
+                        self._health_fails[node_id] = 0
+                        continue
+                    self._health_fails[node_id] = \
+                        self._health_fails.get(node_id, 0) + 1
+                    if self._health_fails[node_id] >= threshold:
+                        self._node_addrs.pop(node_id, None)
+                        self._health_fails.pop(node_id, None)
+                        declare_dead = True
+                if declare_dead:
+                    logger.warning("node %s failed %d health checks; "
+                                   "declaring dead", node_id, threshold)
+                    self.state.remove_node(node_id)
+        for client in clients.values():
+            client.close()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self.server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# process entrypoint
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--port-file", required=True,
+                   help="file to write the bound address to")
+    p.add_argument("--config", default="",
+                   help="serialized system config json")
+    args = p.parse_args(argv)
+    if args.config:
+        get_config().load_serialized(args.config)
+    server = GcsServer()
+    tmp = args.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{server.address[0]}:{server.address[1]}")
+    os.rename(tmp, args.port_file)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+
+
+def spawn_gcs_process(session: str, config_json: str = ""
+                      ) -> Tuple["subprocess.Popen", Tuple[str, int]]:
+    """Start a GCS server as a detached process; returns (proc, addr)."""
+    import subprocess
+    d = os.path.join("/tmp", f"rtpu_{session}")
+    os.makedirs(d, exist_ok=True)
+    port_file = os.path.join(d, "gcs.addr")
+    if os.path.exists(port_file):
+        os.unlink(port_file)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    env["JAX_PLATFORMS"] = "cpu"   # the GCS never touches the TPU
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.gcs_server",
+         "--port-file", port_file, "--config", config_json],
+        env=env, start_new_session=True)
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        if os.path.exists(port_file):
+            host, port = open(port_file).read().strip().rsplit(":", 1)
+            return proc, (host, int(port))
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"gcs server died on startup (rc={proc.returncode})")
+        time.sleep(0.02)
+    proc.terminate()
+    raise TimeoutError("gcs server did not write its address in time")
+
+
+if __name__ == "__main__":
+    main()

@@ -108,6 +108,78 @@ class Raylet:
         self.alive = True
 
 
+class _RemoteLease:
+    """RunningTask.worker sentinel for a normal task leased to a remote
+    raylet (there is no driver-side worker object to release)."""
+
+    is_actor_worker = False
+    kind = "remote"
+
+    def __init__(self, handle: "RemoteNodeHandle"):
+        self.handle = handle
+
+    @property
+    def alive(self) -> bool:
+        return self.handle.alive
+
+
+class RemoteActorWorker:
+    """Driver-side stand-in for a dedicated actor worker living on a
+    remote raylet; routes sends over the node's RPC channel."""
+
+    def __init__(self, handle: "RemoteNodeHandle", actor_id_bytes: bytes):
+        self.handle = handle
+        self.actor_id_bytes = actor_id_bytes
+        self.is_actor_worker = True
+        self.kind = "remote"
+
+    @property
+    def alive(self) -> bool:
+        return self.handle.alive
+
+    def send(self, msg: tuple) -> None:
+        if msg[0] == "shutdown":
+            try:
+                self.handle.client.call("kill_actor", self.actor_id_bytes,
+                                        timeout=5)
+            except Exception:
+                pass
+            return
+        raise RuntimeError("remote actor sends go through submit_actor_task")
+
+    def kill(self) -> None:
+        pass
+
+
+class RemoteNodeHandle:
+    """Driver-side proxy of a raylet process (lease channel + object
+    manager address + liveness)."""
+
+    def __init__(self, group: "NodeManagerGroup", node_id: NodeID,
+                 addr, resources: NodeResources, proc=None):
+        from ray_tpu._private.rpc import RpcClient
+        self.node_id = node_id
+        self.addr = tuple(addr)
+        self.resources = resources
+        self.proc = proc
+        self.alive = True
+        self.known_functions: set = set()
+        self._group = group
+        self.client = RpcClient(self.addr, on_push=self._on_push,
+                                on_close=self._on_close)
+        self.client.call("register_owner")
+
+    def _on_push(self, topic: str, payload) -> None:
+        try:
+            self._group._on_remote_push(self, topic, payload)
+        except Exception:
+            logger.exception("error handling push from %s", self.node_id)
+
+    def _on_close(self) -> None:
+        if self.alive:
+            self._group._on_remote_node_lost(self.node_id)
+
+
 class NodeManagerGroup:
     """Owns all logical raylets plus the scheduling/IO machinery."""
 
@@ -133,6 +205,8 @@ class NodeManagerGroup:
 
         self._lock = threading.RLock()
         self._raylets: Dict[NodeID, Raylet] = {}
+        self._remote_nodes: Dict[NodeID, RemoteNodeHandle] = {}
+        self._object_locations: Dict[ObjectID, NodeID] = {}
         self._waiting: Dict[TaskID, TaskSpec] = {}
         self._to_schedule: deque = deque()
         self._infeasible: Dict[TaskID, TaskSpec] = {}
@@ -142,9 +216,21 @@ class NodeManagerGroup:
 
         self._wake = threading.Event()
         self._shutdown = False
+        self._membership_version = 0   # bumped on node add/remove
 
         from ray_tpu._private.connection_hub import ConnectionHub
         self.hub = ConnectionHub(session)
+
+        # Driver-side object manager: serves this owner's store to
+        # remote raylets pulling argument objects (every node, the head
+        # included, is addressable on the transfer plane).
+        from ray_tpu._private.object_transfer import (
+            PeerClients, serve_store)
+        from ray_tpu._private.rpc import RpcServer
+        self.object_server = RpcServer()
+        serve_store(self.object_server, self._serve_object_view)
+        self.object_server_addr = self.object_server.address
+        self._peer_clients = PeerClients()
 
         self.head_node_id = NodeID.from_random()
         self.add_node(self.head_node_id, driver_node_resources)
@@ -166,6 +252,7 @@ class NodeManagerGroup:
         with self._lock:
             self._raylets[node_id] = raylet
         self.cluster_resources.add_or_update_node(node_id, resources)
+        self._membership_version += 1
         self._wake.set()
         return raylet
 
@@ -202,7 +289,321 @@ class NodeManagerGroup:
 
     def nodes(self) -> List[NodeID]:
         with self._lock:
-            return list(self._raylets)
+            return list(self._raylets) + list(self._remote_nodes)
+
+    # -- remote nodes (raylet processes) -----------------------------------
+
+    def add_remote_node(self, node_id: NodeID, addr,
+                        resources: NodeResources, proc=None
+                        ) -> RemoteNodeHandle:
+        handle = RemoteNodeHandle(self, node_id, addr, resources, proc)
+        with self._lock:
+            self._remote_nodes[node_id] = handle
+        self.cluster_resources.add_or_update_node(node_id, resources)
+        self._membership_version += 1
+        self._wake.set()
+        return handle
+
+    def _serve_object_view(self, oid_bytes: bytes):
+        oid = ObjectID(oid_bytes)
+        view = self._shm_store.get_local(oid)
+        if view is not None:
+            return view
+        if self._ensure_host_copy_cb is not None:
+            info = self._ensure_host_copy_cb(oid)
+            if info is not None:
+                return self._shm_store.get_local(oid)
+        return None
+
+    def record_object_location(self, oid: ObjectID, node_id: NodeID) -> None:
+        with self._lock:
+            self._object_locations[oid] = node_id
+
+    def fetch_remote_object(self, oid: ObjectID, node_id: NodeID,
+                            size: int) -> Optional[bytes]:
+        """Pull an object's bytes from the node holding it. None when
+        the node is gone or no longer has the object (callers route
+        into lineage reconstruction)."""
+        from ray_tpu._private.object_transfer import (
+            ObjectLocationError, pull_object)
+        with self._lock:
+            handle = self._remote_nodes.get(node_id)
+        if handle is None or not handle.alive:
+            return None
+        try:
+            return pull_object(self._peer_clients.get(handle.addr),
+                               oid.binary(), size)
+        except (ObjectLocationError, ConnectionError, OSError, TimeoutError):
+            return None
+
+    def _localize_remote_entry(self, oid: ObjectID, entry) -> bool:
+        """Pull a remote-located object into the driver's store and
+        rewrite its directory entry to a local shm entry. False when
+        the holder is gone (callers route into reconstruction)."""
+        loc_node, size = entry.data
+        if not self._shm_store.contains(oid):
+            blob = self.fetch_remote_object(oid, loc_node, size)
+            if blob is None:
+                return False
+            try:
+                self._shm_store.put_blob(oid, blob)
+            except ValueError:
+                pass          # raced another localization
+        info = self._shm_store.segment_for(oid)
+        if info is None:
+            return False
+        entry.kind = "shm"
+        entry.data = info
+        return True
+
+    def _node_addr_for_object(self, oid: ObjectID):
+        """Transfer-plane address serving ``oid``: the holder node's, or
+        the driver's own object server for locally-stored objects."""
+        with self._lock:
+            loc = self._object_locations.get(oid)
+            if loc is not None:
+                handle = self._remote_nodes.get(loc)
+                if handle is not None and handle.alive:
+                    return handle.addr
+                return None       # holder died: object lost
+        return self.object_server_addr
+
+    def _dispatch_remote(self, handle: RemoteNodeHandle, spec: TaskSpec
+                         ) -> None:
+        """Ship a scheduled task to a remote raylet (lease+exec)."""
+        payload, err = self._build_remote_payload(handle, spec)
+        if err is not None:
+            self._free_allocation(handle.node_id, spec.resources,
+                                  self._spec_pg(spec))
+            if isinstance(err, _DependencyError):
+                self._complete_task(spec.task_id, [], err.entry.data, None)
+            elif isinstance(err, _LostArgError):
+                recovered = (self._recover_object_cb(err.object_id)
+                             if self._recover_object_cb else False)
+                if recovered:
+                    self.submit_task(spec)
+                elif self._fail_task_cb is not None:
+                    from ray_tpu.exceptions import ObjectLostError
+                    self._fail_task_cb(spec, ObjectLostError(
+                        f"argument {err.object_id} of {spec.repr_name()} "
+                        "was lost and cannot be reconstructed"))
+            else:
+                self._complete_task(spec.task_id, [], None, err)
+            return
+        with self._lock:
+            self._running[spec.task_id] = RunningTask(
+                spec, handle.node_id, _RemoteLease(handle),
+                dict(spec.resources), pg=self._spec_pg(spec))
+        try:
+            status = handle.client.call("submit", payload, timeout=30)
+        except Exception:
+            with self._lock:
+                self._running.pop(spec.task_id, None)
+            self._free_allocation(handle.node_id, spec.resources,
+                                  self._spec_pg(spec))
+            with self._lock:
+                self._to_schedule.append(spec)
+            self._wake.set()
+            return
+        if status == "refused":
+            # Spillback: the raylet's authoritative view says this can
+            # never fit; reschedule elsewhere.
+            with self._lock:
+                self._running.pop(spec.task_id, None)
+            self._free_allocation(handle.node_id, spec.resources,
+                                  self._spec_pg(spec))
+            with self._lock:
+                self._to_schedule.append(spec)
+            self._wake.set()
+            return
+        from ray_tpu._private import events
+        events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
+                      worker=f"node:{handle.node_id.hex()[:8]}")
+
+    def _build_remote_payload(self, handle: RemoteNodeHandle,
+                              spec: TaskSpec):
+        """Args for a remote node: inline values travel as bytes;
+        object args travel as ("pull", oid, holder_addr, size) —
+        the raylet fetches them over the transfer plane."""
+        arg_descs = []
+        for arg in spec.args:
+            if arg.object_id is None:
+                arg_descs.append(("v", arg.inline_blob))
+                continue
+            oid = arg.object_id
+            try:
+                entry = self._memory_store.get(oid, timeout=0)
+            except TimeoutError:
+                return None, _LostArgError(oid)
+            if entry.kind == "err":
+                return None, _DependencyError(entry)
+            if entry.kind == "blob":
+                arg_descs.append(("v", entry.data))
+                continue
+            if entry.kind == "device":
+                info = (self._ensure_host_copy_cb(oid)
+                        if self._ensure_host_copy_cb else None)
+                if info is None:
+                    return None, _LostArgError(oid)
+                arg_descs.append(("pull", oid.binary(),
+                                  self.object_server_addr, info[1]))
+                continue
+            if entry.kind == "remote":
+                loc_node, size = entry.data
+                addr = self._node_addr_for_object(oid)
+                if addr is None:
+                    return None, _LostArgError(oid)
+                arg_descs.append(("pull", oid.binary(), addr, size))
+                continue
+            # shm in the driver store
+            info = self._shm_store.segment_for(oid)
+            if info is None:
+                return None, _LostArgError(oid)
+            arg_descs.append(("pull", oid.binary(),
+                              self.object_server_addr, info[1]))
+        payload = {
+            "type": ("create_actor"
+                     if spec.task_type == TaskType.ACTOR_CREATION_TASK
+                     else "exec"),
+            "task_id": spec.task_id.binary(),
+            "function_id": spec.function.function_id,
+            "args": arg_descs,
+            "kwargs_keys": spec.kwargs_keys,
+            "num_returns": spec.num_returns,
+            "return_ids": [o.binary() for o in spec.return_ids],
+            "name": spec.repr_name(),
+            "resources": dict(spec.resources),
+        }
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            payload["actor_id"] = spec.actor_creation_id.binary()
+        fid = spec.function.function_id
+        if fid not in handle.known_functions:
+            payload["function_blob"] = self._function_blob(fid)
+            handle.known_functions.add(fid)
+        return payload, None
+
+    # -- remote completion routing -----------------------------------------
+
+    def _on_remote_push(self, handle: RemoteNodeHandle, topic: str,
+                        payload) -> None:
+        if topic == "task_done":
+            self._complete_remote_task(handle, payload)
+        elif topic == "actor_ready":
+            self._remote_actor_ready(handle, payload)
+        elif topic == "actor_died":
+            self._remote_actor_died(handle, payload)
+
+    def _complete_remote_task(self, handle: RemoteNodeHandle,
+                              msg: dict) -> None:
+        task_id = TaskID(msg["task_id"])
+        with self._lock:
+            rt = self._running.pop(task_id, None)
+        if rt is None:
+            return
+        is_actor_task = rt.spec.task_type == TaskType.ACTOR_TASK
+        if not is_actor_task:
+            self._free_allocation(rt.node_id, rt.resources, rt.pg)
+            self._wake.set()
+        lost_arg = msg.get("lost_arg")
+        if lost_arg is not None and self._recover_object_cb is not None:
+            if self._recover_object_cb(ObjectID(lost_arg)):
+                self.submit_task(rt.spec)
+                return
+        sys_err = None
+        if msg.get("system_error"):
+            sys_err = WorkerCrashedError(msg["system_error"])
+        results = []
+        for oid_b, kind, data, contained in msg.get("results", ()):
+            if kind == "remote":
+                oid = ObjectID(oid_b)
+                self.record_object_location(oid, handle.node_id)
+                results.append((oid_b, "remote", (handle.node_id, data),
+                                contained))
+            else:
+                results.append((oid_b, kind, data, contained))
+        self._complete_task(task_id, results, msg.get("error_blob"), sys_err)
+
+    def _remote_actor_ready(self, handle: RemoteNodeHandle,
+                            msg: dict) -> None:
+        actor_id_b = msg["actor_id"]
+        err_blob = msg.get("error_blob")
+        task_id = None
+        with self._lock:
+            for tid, rt in self._running.items():
+                if (rt.spec.task_type == TaskType.ACTOR_CREATION_TASK
+                        and rt.spec.actor_creation_id.binary() == actor_id_b):
+                    task_id = tid
+                    break
+            rt = self._running.pop(task_id, None) if task_id else None
+        if rt is None:
+            return
+        if err_blob is not None:
+            self._free_allocation(rt.node_id, rt.resources, rt.pg)
+            self._complete_task(task_id, [], err_blob, None)
+        else:
+            self.register_actor_worker(
+                ActorID(actor_id_b), rt.node_id,
+                RemoteActorWorker(handle, actor_id_b), rt.resources,
+                pg=rt.pg)
+            self._complete_task(task_id, [], None, None)
+
+    def _remote_actor_died(self, handle: RemoteNodeHandle,
+                           msg: dict) -> None:
+        actor_id = ActorID(msg["actor_id"])
+        with self._lock:
+            entry = self._actor_workers.pop(actor_id, None)
+        if entry is not None:
+            nid, _w, res, pg = entry
+            self._free_allocation(nid, res, pg)
+            if self._actor_death_cb is not None:
+                self._actor_death_cb(actor_id)
+        self._wake.set()
+
+    def _on_remote_node_lost(self, node_id: NodeID) -> None:
+        """A raylet process died (connection lost or GCS health). Fail
+        its running tasks (they retry on survivors); its objects stay
+        recorded and reconstruct lazily on access."""
+        with self._lock:
+            handle = self._remote_nodes.pop(node_id, None)
+            if handle is None:
+                return
+            handle.alive = False
+            dead_tasks = [tid for tid, rt in self._running.items()
+                          if rt.node_id == node_id]
+            dead_actors = [aid for aid, (nid, _w, _r, _p)
+                           in self._actor_workers.items() if nid == node_id]
+        logger.warning("remote node %s lost; failing %d running tasks",
+                       node_id.hex()[:8], len(dead_tasks))
+        if self.pg_manager is not None:
+            self.pg_manager.on_node_removed(node_id)
+        self.cluster_resources.remove_node(node_id)
+        for tid in dead_tasks:
+            self._fail_running(tid, WorkerCrashedError(
+                f"node {node_id.hex()[:8]} died"))
+        for aid in dead_actors:
+            with self._lock:
+                entry = self._actor_workers.pop(aid, None)
+            if entry is not None and self._actor_death_cb is not None:
+                self._actor_death_cb(aid)
+        try:
+            handle.client.close()
+        except Exception:
+            pass
+        self._wake.set()
+
+    def remove_remote_node(self, node_id: NodeID, kill_process: bool = True
+                           ) -> None:
+        with self._lock:
+            handle = self._remote_nodes.get(node_id)
+        if handle is None:
+            return
+        proc = handle.proc
+        self._on_remote_node_lost(node_id)
+        if kill_process and proc is not None:
+            try:
+                proc.terminate()
+            except Exception:
+                pass
 
     # -- submission --------------------------------------------------------
 
@@ -262,10 +663,73 @@ class NodeManagerGroup:
             _, worker, _, _ = entry
             self._running[spec.task_id] = RunningTask(
                 spec, entry[0], worker, {})
+        if isinstance(worker, RemoteActorWorker):
+            if not self._rewrite_actor_args_for_remote(worker.handle,
+                                                       payload):
+                with self._lock:
+                    self._running.pop(spec.task_id, None)
+                return False
+            payload = dict(payload, resources={},
+                           function_id=payload["function_id"])
+            try:
+                worker.handle.client.call("submit", payload, timeout=30)
+            except Exception:
+                with self._lock:
+                    self._running.pop(spec.task_id, None)
+                return False
+            from ray_tpu._private import events
+            events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
+                          worker=f"node:{worker.handle.node_id.hex()[:8]}")
+            return True
+        if not self._rewrite_actor_args_for_local(payload):
+            with self._lock:
+                self._running.pop(spec.task_id, None)
+            return False
         worker.send(("exec_actor", payload))
         from ray_tpu._private import events
         events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
                       worker=worker.worker_id.hex()[:8])
+        return True
+
+    def _rewrite_actor_args_for_local(self, payload: dict) -> bool:
+        """Localize remote-located args for an actor on a driver-process
+        (logical) node. False => caller requeues the task."""
+        for i, desc in enumerate(payload["args"]):
+            if desc[0] != "remote":
+                continue
+            oid = ObjectID(desc[1])
+            try:
+                entry = self._memory_store.get(oid, timeout=0)
+            except TimeoutError:
+                return False
+            if entry.kind == "remote":
+                if not self._localize_remote_entry(oid, entry):
+                    if self._recover_object_cb is not None:
+                        self._recover_object_cb(oid)
+                    return False
+            if entry.kind != "shm":
+                return False
+            name, size = entry.data
+            payload["args"][i] = ("shm", desc[1], name, size)
+        return True
+
+    def _rewrite_actor_args_for_remote(self, handle: "RemoteNodeHandle",
+                                       payload: dict) -> bool:
+        """Turn owner-store descriptors into pull descriptors for a
+        remote actor's raylet. False => caller requeues the task."""
+        for i, desc in enumerate(payload["args"]):
+            if desc[0] == "shm":
+                _, oid_b, _name, size = desc
+                payload["args"][i] = ("pull", oid_b,
+                                      self.object_server_addr, size)
+            elif desc[0] == "remote":
+                _, oid_b, _node, size = desc
+                addr = self._node_addr_for_object(ObjectID(oid_b))
+                if addr is None:
+                    if self._recover_object_cb is not None:
+                        self._recover_object_cb(ObjectID(oid_b))
+                    return False
+                payload["args"][i] = ("pull", oid_b, addr, size)
         return True
 
     def release_actor(self, actor_id: ActorID, kill_worker: bool = True
@@ -290,10 +754,20 @@ class NodeManagerGroup:
     def _scheduling_loop(self) -> None:
         cfg = get_config()
         batch_limit = cfg.tpu_scheduler_batch_size
+        seen_membership = -1
         while not self._shutdown:
             self._wake.wait(timeout=0.1)
             self._wake.clear()
             try:
+                # Membership changed since tasks were parked infeasible:
+                # a new node may satisfy them now.
+                if self._membership_version != seen_membership:
+                    seen_membership = self._membership_version
+                    with self._lock:
+                        if self._infeasible:
+                            self._to_schedule.extend(
+                                self._infeasible.values())
+                            self._infeasible.clear()
                 if self.pg_manager is not None:
                     self.pg_manager.try_schedule_pending()
                 self._schedule_once(batch_limit)
@@ -336,6 +810,16 @@ class NodeManagerGroup:
             return
         node_id, resolved_index = alloc
         spec.placement_group_bundle_index = resolved_index
+        with self._lock:
+            remote = self._remote_nodes.get(node_id)
+        if remote is not None:
+            if not remote.alive:
+                self.pg_manager.free_to_bundle(pg_id, resolved_index,
+                                               spec.resources)
+                retry.append(spec)
+            else:
+                self._dispatch_remote(remote, spec)
+            return
         with self._lock:
             raylet = self._raylets.get(node_id)
             if raylet is None or not raylet.alive:
@@ -385,6 +869,15 @@ class NodeManagerGroup:
             if not self.cluster_resources.allocate(res.node_id,
                                                    spec.resources):
                 retry.append(spec)
+                continue
+            with self._lock:
+                remote = self._remote_nodes.get(res.node_id)
+            if remote is not None:
+                if not remote.alive:
+                    self.cluster_resources.free(res.node_id, spec.resources)
+                    retry.append(spec)
+                else:
+                    self._dispatch_remote(remote, spec)
                 continue
             with self._lock:
                 raylet = self._raylets.get(res.node_id)
@@ -484,6 +977,15 @@ class NodeManagerGroup:
                     return _LostArgError(arg.object_id)
                 arg_descs.append(("shm", arg.object_id.binary(),
                                   info[0], info[1]))
+            elif entry.kind == "remote":
+                # Object lives on a remote node; pull it into the local
+                # store before dispatching to a local worker.
+                if not self._localize_remote_entry(arg.object_id, entry):
+                    with self._lock:
+                        self._running.pop(spec.task_id, None)
+                    return _LostArgError(arg.object_id)
+                name, size = entry.data
+                arg_descs.append(("shm", arg.object_id.binary(), name, size))
             else:  # shm
                 if not self._shm_store.contains(arg.object_id):
                     with self._lock:
@@ -657,10 +1159,26 @@ class NodeManagerGroup:
         self._wake.set()
         with self._lock:
             raylets = list(self._raylets.values())
+            remotes = list(self._remote_nodes.values())
+            self._remote_nodes.clear()
+        for handle in remotes:
+            handle.alive = False    # suppress on_close node-lost handling
+            try:
+                handle.client.call("shutdown", timeout=2)
+            except Exception:
+                pass
+            handle.client.close()
+            if handle.proc is not None:
+                try:
+                    handle.proc.wait(timeout=5)
+                except Exception:
+                    handle.proc.terminate()
         for raylet in raylets:
             raylet.worker_pool.shutdown()
         self._sched_thread.join(timeout=2)
         self._io_thread.join(timeout=2)
+        self._peer_clients.close()
+        self.object_server.shutdown()
         self.hub.shutdown()
 
     def stats(self) -> dict:

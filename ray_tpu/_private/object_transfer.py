@@ -1,0 +1,99 @@
+"""Chunked node-to-node object transfer.
+
+Reference: ``src/ray/object_manager/`` — PullManager/PushManager moving
+objects between plasma stores in ~5 MiB chunks through
+``ObjectBufferPool`` [UNVERIFIED — mount empty, SURVEY.md §0]. Every
+node (including the driver) serves its local store over the wire RPC
+layer; consumers pull missing objects chunk-by-chunk
+(``object_chunk_size_bytes``) and seal them into their own store.
+Within a node the shm plane stays zero-copy; this path is only taken
+across node boundaries.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.rpc import RpcClient, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class ObjectLocationError(Exception):
+    """The serving node no longer has the object."""
+
+
+def serve_store(server: RpcServer, get_view: Callable[[bytes], Optional[memoryview]],
+                free_fn: Optional[Callable[[bytes], None]] = None) -> None:
+    """Register object-manager handlers on an RpcServer.
+
+    ``get_view(oid_bytes)`` returns a zero-copy memoryview of the sealed
+    object (restoring spilled copies as needed) or None.
+    """
+
+    def fetch_object(ctx, oid_bytes: bytes, offset: int, length: int):
+        view = get_view(oid_bytes)
+        if view is None:
+            return None
+        return bytes(view[offset:offset + length])
+
+    def object_info(ctx, oid_bytes: bytes):
+        view = get_view(oid_bytes)
+        return None if view is None else len(view)
+
+    def free_object(ctx, oid_bytes: bytes):
+        if free_fn is not None:
+            free_fn(oid_bytes)
+
+    server.register("fetch_object", fetch_object)
+    server.register("object_info", object_info)
+    server.register("free_object", free_object)
+
+
+def pull_object(client: RpcClient, oid_bytes: bytes, size: int,
+                chunk_size: Optional[int] = None,
+                timeout: float = 60.0) -> bytes:
+    """Pull a whole object from a peer's store in bounded chunks."""
+    if chunk_size is None:
+        chunk_size = get_config().object_chunk_size_bytes
+    buf = bytearray(size)
+    off = 0
+    while off < size:
+        n = min(chunk_size, size - off)
+        data = client.call("fetch_object", oid_bytes, off, n,
+                           timeout=timeout)
+        if data is None:
+            raise ObjectLocationError(
+                f"peer no longer has object {oid_bytes.hex()[:16]}")
+        buf[off:off + len(data)] = data
+        off += len(data)
+        if not data:
+            raise ObjectLocationError("peer returned empty chunk")
+    return bytes(buf)
+
+
+class PeerClients:
+    """Cache of RpcClients to peer object managers, keyed by address."""
+
+    def __init__(self):
+        self._clients: Dict[Tuple[str, int], RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, addr: Tuple[str, int]) -> RpcClient:
+        addr = tuple(addr)
+        with self._lock:
+            client = self._clients.get(addr)
+            if client is None or not client.alive:
+                client = RpcClient(addr)
+                self._clients[addr] = client
+            return client
+
+    def close(self) -> None:
+        with self._lock:
+            for client in self._clients.values():
+                client.close()
+            self._clients.clear()

@@ -1,0 +1,514 @@
+"""Per-node raylet process.
+
+Reference: ``src/ray/raylet/`` — ``main.cc`` starting a per-node
+``NodeManager`` (worker leasing + dispatch), local object store, and
+object manager, talking to the GCS and to the task owner over RPC
+[UNVERIFIED — mount empty, SURVEY.md §0].
+
+One process per (logical or physical) node:
+
+- owns a **node-local ShmStore** in its own namespace — objects on this
+  node are NOT host-shared with other nodes; crossing nodes goes
+  through the chunked transfer plane (``object_transfer.py``), exactly
+  as it would over DCN,
+- owns a **WorkerPool** of exec'd worker subprocesses (same execution
+  core as the head node's),
+- serves **leases**: the owner (driver) sends task payloads; the raylet
+  resolves argument objects (local shm hit, else pull from the peer
+  holding them), dispatches to a leased worker, seals results locally,
+  and pushes completions back on the owner's channel — big results stay
+  node-local and only their location travels,
+- **registers with the GCS** and heartbeats resource reports; the GCS
+  health manager declares it dead when pings stop.
+
+Spillback: a lease whose demand cannot EVER fit this node's total
+resources is refused back to the owner for rescheduling (the wrong-
+guess correction of the reference's two-level scheduling).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.gcs import NodeInfo
+from ray_tpu._private.gcs_client import GcsClient
+from ray_tpu._private.ids import NodeID, ObjectID
+from ray_tpu._private.object_store import ShmStore, _segment_name
+from ray_tpu._private.object_transfer import (
+    ObjectLocationError,
+    PeerClients,
+    pull_object,
+    serve_store,
+)
+from ray_tpu._private.rpc import ConnectionContext, RpcServer
+from ray_tpu._private.worker_pool import BaseWorker, ProcessWorker, WorkerPool
+
+logger = logging.getLogger(__name__)
+
+
+class RayletServer:
+    def __init__(self, session: str, node_id: NodeID,
+                 resources_total: Dict[str, float],
+                 gcs_addr: Optional[Tuple[str, int]] = None,
+                 max_process_workers: int = 2,
+                 object_store_memory: Optional[int] = None,
+                 labels: Optional[Dict[str, str]] = None):
+        cfg = get_config()
+        self.node_id = node_id
+        self.session = session          # node-scoped namespace
+        self.resources_total = dict(resources_total)
+        self.labels = dict(labels or {})
+        self.shm_store = ShmStore(
+            session, object_store_memory or cfg.object_store_memory_bytes,
+            spill_threshold=cfg.object_spilling_threshold)
+        self._functions: Dict[bytes, bytes] = {}
+        self._peers = PeerClients()
+        self._owner_ctx: Optional[ConnectionContext] = None
+        self._owner_lock = threading.Lock()
+
+        from ray_tpu._private.connection_hub import ConnectionHub
+        self.hub = ConnectionHub(session)
+        self.worker_pool = WorkerPool(
+            session, self.hub, self._unused_inproc_reply, self._wake_dispatch,
+            max_process_workers=max_process_workers)
+
+        self._lock = threading.RLock()
+        self._dispatch_queue: deque = deque()
+        self._running: Dict[bytes, BaseWorker] = {}   # task_id -> worker
+        self._actor_workers: Dict[bytes, BaseWorker] = {}
+        self._creation_tasks: Dict[bytes, bytes] = {}  # actor_id -> task_id
+        self._wake = threading.Event()
+        self._shutdown = threading.Event()
+        self.num_pulled = 0   # objects fetched from peers (transfer stat)
+
+        self.server = RpcServer()
+        self.address = self.server.address
+        serve_store(self.server, self._object_view, self._free_object)
+        self.server.register("ping", lambda ctx: "pong")
+        self.server.register("register_owner", self._register_owner)
+        self.server.register("stats", lambda ctx: self.stats())
+        self.server.register("submit", self._handle_submit)
+        self.server.register("kill_actor", self._handle_kill_actor)
+        self.server.register("shutdown", lambda ctx: self._request_shutdown())
+
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="rtpu-raylet-disp")
+        self._io_thread = threading.Thread(
+            target=self._io_loop, daemon=True, name="rtpu-raylet-io")
+        self._dispatch_thread.start()
+        self._io_thread.start()
+
+        self.gcs: Optional[GcsClient] = None
+        if gcs_addr is not None:
+            self.gcs = GcsClient(gcs_addr)
+            self.gcs.register_node(
+                NodeInfo(node_id=node_id,
+                         resources_total=dict(self.resources_total),
+                         labels=self.labels),
+                rpc_addr=self.address)
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True, name="rtpu-raylet-hb")
+            self._hb_thread.start()
+
+    # -- object manager ------------------------------------------------
+
+    def _object_view(self, oid_bytes: bytes):
+        return self.shm_store.get_local(ObjectID(oid_bytes))
+
+    def _free_object(self, oid_bytes: bytes) -> None:
+        self.shm_store.free(ObjectID(oid_bytes))
+
+    # -- owner channel -------------------------------------------------
+
+    def _register_owner(self, ctx: ConnectionContext) -> str:
+        with self._owner_lock:
+            self._owner_ctx = ctx
+        return "ok"
+
+    def _push_owner(self, topic: str, payload) -> None:
+        with self._owner_lock:
+            ctx = self._owner_ctx
+        if ctx is None or not ctx.push(topic, payload):
+            logger.warning("owner channel gone; dropping %s", topic)
+
+    # -- lease / submit path -------------------------------------------
+
+    def _handle_submit(self, ctx: ConnectionContext, payload: dict) -> str:
+        """Admit a task payload. Returns "ok" or "refused" (spillback:
+        the demand can never fit this node)."""
+        demand = payload.get("resources") or {}
+        for name, need in demand.items():
+            if need > self.resources_total.get(name, 0.0) + 1e-9:
+                return "refused"
+        blob = payload.pop("function_blob", None)
+        if blob is not None:
+            self._functions[payload["function_id"]] = blob
+        with self._lock:
+            self._dispatch_queue.append(payload)
+        self._wake.set()
+        return "ok"
+
+    def _handle_kill_actor(self, ctx: ConnectionContext,
+                           actor_id: bytes) -> None:
+        with self._lock:
+            worker = self._actor_workers.pop(actor_id, None)
+        if worker is not None:
+            try:
+                worker.send(("shutdown",))
+            except Exception:
+                pass
+            worker.kill()
+            self.worker_pool.remove_worker(worker)
+
+    def _wake_dispatch(self) -> None:
+        self._wake.set()
+
+    def _unused_inproc_reply(self, worker, reply) -> None:
+        # Remote raylets never host in-process (TPU) workers: exactly
+        # one process per host owns the TPU runtime — the head node.
+        self._handle_worker_reply(worker, reply)
+
+    def _dispatch_loop(self) -> None:
+        while not self._shutdown.is_set():
+            self._wake.wait(timeout=0.1)
+            self._wake.clear()
+            try:
+                self._dispatch_all()
+            except Exception:
+                logger.exception("raylet dispatch error")
+
+    def _dispatch_all(self) -> None:
+        while True:
+            with self._lock:
+                if not self._dispatch_queue:
+                    return
+                payload = self._dispatch_queue.popleft()
+            if payload["type"] == "exec_actor":
+                self._dispatch_actor_task(payload)
+                continue
+            dedicated = payload["type"] == "create_actor"
+            worker = self.worker_pool.pop_worker(
+                payload.get("resources") or {"CPU": 1}, dedicated)
+            if worker is None:
+                with self._lock:
+                    self._dispatch_queue.appendleft(payload)
+                return
+            self._run_on_worker(worker, payload)
+
+    def _dispatch_actor_task(self, payload: dict) -> None:
+        actor_id = payload["actor_id"]
+        with self._lock:
+            worker = self._actor_workers.get(actor_id)
+        if worker is None or not worker.alive:
+            self._push_owner("task_done", {
+                "task_id": payload["task_id"], "results": [],
+                "error_blob": None, "system_error": "actor worker dead"})
+            return
+        self._run_on_worker(worker, payload, actor=True)
+
+    def _run_on_worker(self, worker: BaseWorker, payload: dict,
+                       actor: bool = False) -> None:
+        try:
+            self._localize_args(payload)
+        except ObjectLocationError as e:
+            if not actor:
+                self.worker_pool.push_worker(worker)
+            self._push_owner("task_done", {
+                "task_id": payload["task_id"], "results": [],
+                "error_blob": None, "system_error": f"lost argument: {e}",
+                "lost_arg": getattr(e, "oid_bytes", None)})
+            return
+        fid = payload["function_id"]
+        try:
+            self.worker_pool.ensure_function(
+                worker, fid, lambda: self._functions[fid])
+            with self._lock:
+                self._running[payload["task_id"]] = worker
+                if payload["type"] == "create_actor":
+                    self._creation_tasks[payload["actor_id"]] = \
+                        payload["task_id"]
+            worker.send((payload["type"], payload))
+        except Exception as e:
+            with self._lock:
+                self._running.pop(payload["task_id"], None)
+            if not actor:
+                self.worker_pool.push_worker(worker)
+            self._push_owner("task_done", {
+                "task_id": payload["task_id"], "results": [],
+                "error_blob": None,
+                "system_error": f"worker send failed: {e}"})
+
+    def _localize_args(self, payload: dict) -> None:
+        """Rewrite ("pull", oid, addr, size) arg descriptors into local
+        ("shm", ...) ones, fetching missing objects from peers."""
+        args = payload["args"]
+        for i, desc in enumerate(args):
+            if desc[0] != "pull":
+                continue
+            _, oid_bytes, addr, size = desc
+            oid = ObjectID(oid_bytes)
+            if not self.shm_store.contains(oid):
+                client = self._peers.get(tuple(addr))
+                try:
+                    blob = pull_object(client, oid_bytes, size)
+                except (ConnectionError, OSError) as e:
+                    err = ObjectLocationError(str(e))
+                    err.oid_bytes = oid_bytes
+                    raise err
+                except ObjectLocationError as e:
+                    e.oid_bytes = oid_bytes
+                    raise
+                try:
+                    self.shm_store.put_blob(oid, blob)
+                except ValueError:
+                    pass      # raced another pull of the same object
+                self.num_pulled += 1
+            info = self.shm_store.segment_for(oid)
+            if info is None:
+                err = ObjectLocationError(
+                    f"object {oid} evicted during localization")
+                err.oid_bytes = oid_bytes
+                raise err
+            args[i] = ("shm", oid_bytes, info[0], info[1])
+
+    # -- worker replies ------------------------------------------------
+
+    def _io_loop(self) -> None:
+        from multiprocessing.connection import wait as conn_wait
+        while not self._shutdown.is_set():
+            conns = self.worker_pool.process_connections()
+            if not conns:
+                time.sleep(0.01)
+                continue
+            for c in conn_wait(conns, timeout=0.1):
+                worker = self.worker_pool.worker_by_conn(c)
+                if worker is None:
+                    continue
+                try:
+                    msg = c.recv()
+                except (EOFError, OSError):
+                    try:
+                        self._on_worker_death(worker)
+                    except Exception:
+                        logger.exception("worker-death handling failed")
+                    continue
+                try:
+                    if msg[0] == "ready":
+                        worker.ready = True
+                    elif msg[0] == "pong":
+                        pass
+                    else:
+                        self._handle_worker_reply(worker, msg)
+                except Exception:
+                    logger.exception("worker reply handling failed")
+
+    def _handle_worker_reply(self, worker: BaseWorker, reply: tuple) -> None:
+        op = reply[0]
+        if op == "done":
+            _, task_id, results, err_blob = reply
+            with self._lock:
+                self._running.pop(task_id, None)
+            if not worker.is_actor_worker:
+                self.worker_pool.push_worker(worker)
+            # Seal big results into the node store; ship locations.
+            shipped = []
+            for oid_b, kind, data, contained in results:
+                if kind == "shm":
+                    name, size = data
+                    try:
+                        self.shm_store.adopt(ObjectID(oid_b), size)
+                    except FileNotFoundError:
+                        logger.warning("result segment vanished: %s",
+                                       name)
+                    shipped.append((oid_b, "remote", size, contained))
+                else:
+                    shipped.append((oid_b, kind, data, contained))
+            self._push_owner("task_done", {
+                "task_id": task_id, "results": shipped,
+                "error_blob": err_blob, "system_error": None})
+        elif op == "actor_ready":
+            _, actor_id, err_blob = reply
+            with self._lock:
+                tid = self._creation_tasks.pop(actor_id, None)
+                if tid is not None:
+                    self._running.pop(tid, None)
+            if err_blob is None:
+                with self._lock:
+                    self._actor_workers[actor_id] = worker
+            else:
+                self.worker_pool.remove_worker(worker)
+                try:
+                    worker.send(("shutdown",))
+                except Exception:
+                    pass
+            self._push_owner("actor_ready", {
+                "actor_id": actor_id, "error_blob": err_blob})
+
+    def _on_worker_death(self, worker: BaseWorker) -> None:
+        self.worker_pool.remove_worker(worker)
+        worker.kill()
+        dead_tasks: List[bytes] = []
+        dead_actors: List[bytes] = []
+        with self._lock:
+            for tid, w in list(self._running.items()):
+                if w is worker:
+                    dead_tasks.append(tid)
+                    self._running.pop(tid)
+            for aid, w in list(self._actor_workers.items()):
+                if w is worker:
+                    dead_actors.append(aid)
+                    self._actor_workers.pop(aid)
+        for tid in dead_tasks:
+            self._push_owner("task_done", {
+                "task_id": tid, "results": [], "error_blob": None,
+                "system_error": "worker process died while executing task"})
+        for aid in dead_actors:
+            self._push_owner("actor_died", {"actor_id": aid})
+        self._wake.set()
+
+    # -- gcs heartbeat -------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        cfg = get_config()
+        period = cfg.health_check_period_ms / 1000.0
+        while not self._shutdown.wait(period):
+            try:
+                # Report free capacity: total minus what running tasks
+                # nominally demand (the owner keeps the authoritative
+                # allocation ledger; this feeds observers/autoscaling).
+                self.gcs.report_resources(self.node_id,
+                                          dict(self.resources_total))
+            except Exception:
+                pass
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _request_shutdown(self) -> str:
+        threading.Thread(target=self.shutdown, daemon=True).start()
+        return "ok"
+
+    def shutdown(self) -> None:
+        if self._shutdown.is_set():
+            return
+        self._shutdown.set()
+        self.worker_pool.shutdown()
+        self.server.shutdown()
+        self._peers.close()
+        self.shm_store.shutdown()
+        self.hub.shutdown()
+        if self.gcs is not None:
+            self.gcs.close()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "node_id": self.node_id.hex(),
+                "queued": len(self._dispatch_queue),
+                "running": len(self._running),
+                "actors": len(self._actor_workers),
+                "num_pulled": self.num_pulled,
+                "store": self.shm_store.stats(),
+                "workers": self.worker_pool.stats(),
+            }
+
+
+# ---------------------------------------------------------------------------
+# process entrypoint
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--session", required=True)
+    p.add_argument("--node-id", required=True, help="hex node id")
+    p.add_argument("--resources", required=True,
+                   help="json dict of total resources")
+    p.add_argument("--labels", default="{}")
+    p.add_argument("--gcs", default="", help="host:port of the GCS")
+    p.add_argument("--port-file", required=True)
+    p.add_argument("--max-process-workers", type=int, default=2)
+    p.add_argument("--object-store-memory", type=int, default=0)
+    p.add_argument("--config", default="")
+    args = p.parse_args(argv)
+
+    import json
+    if args.config:
+        get_config().load_serialized(args.config)
+    gcs_addr = None
+    if args.gcs:
+        host, port = args.gcs.rsplit(":", 1)
+        gcs_addr = (host, int(port))
+    raylet = RayletServer(
+        session=args.session,
+        node_id=NodeID.from_hex(args.node_id),
+        resources_total=json.loads(args.resources),
+        gcs_addr=gcs_addr,
+        max_process_workers=args.max_process_workers,
+        object_store_memory=args.object_store_memory or None,
+        labels=json.loads(args.labels))
+    tmp = args.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{raylet.address[0]}:{raylet.address[1]}")
+    os.rename(tmp, args.port_file)
+    try:
+        while not raylet._shutdown.wait(1.0):
+            pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        raylet.shutdown()
+
+
+def spawn_raylet_process(session: str, node_id: NodeID,
+                         resources_total: Dict[str, float],
+                         gcs_addr: Optional[Tuple[str, int]] = None,
+                         max_process_workers: int = 2,
+                         labels: Optional[Dict[str, str]] = None,
+                         object_store_memory: int = 0):
+    """Spawn a raylet as a separate process; returns (proc, addr)."""
+    import json
+    import subprocess
+    d = os.path.join("/tmp", f"rtpu_{session}")
+    os.makedirs(d, exist_ok=True)
+    port_file = os.path.join(d, f"raylet_{node_id.hex()[:12]}.addr")
+    if os.path.exists(port_file):
+        os.unlink(port_file)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    env["JAX_PLATFORMS"] = "cpu"      # remote raylets never own the TPU
+    cmd = [sys.executable, "-m", "ray_tpu._private.raylet_server",
+           "--session", session, "--node-id", node_id.hex(),
+           "--resources", json.dumps(resources_total),
+           "--labels", json.dumps(labels or {}),
+           "--port-file", port_file,
+           "--max-process-workers", str(max_process_workers),
+           "--object-store-memory", str(object_store_memory),
+           "--config", get_config().serialize()]
+    if gcs_addr is not None:
+        cmd += ["--gcs", f"{gcs_addr[0]}:{gcs_addr[1]}"]
+    proc = subprocess.Popen(cmd, env=env, start_new_session=True)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        if os.path.exists(port_file):
+            host, port = open(port_file).read().strip().rsplit(":", 1)
+            return proc, (host, int(port))
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"raylet died on startup (rc={proc.returncode})")
+        time.sleep(0.02)
+    proc.terminate()
+    raise TimeoutError("raylet did not write its address in time")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,293 @@
+"""Wire RPC layer: framed request/reply + server push over TCP.
+
+Reference analog: ``src/ray/rpc/`` (GrpcServer/ClientCallManager and
+the retryable client) [UNVERIFIED — mount empty, SURVEY.md §0]. The
+reference generates gRPC services from protos; here the control plane
+is a compact framed protocol over TCP sockets — host:port addressable,
+so the same code paths serve multi-process-on-one-host (tests) and
+multi-host over DCN. Payloads are pickled tuples (the data plane's bulk
+bytes ride the same frames; zero-copy within a host stays on the shm
+plane, this layer is the *transfer* path between stores).
+
+Frame: 8-byte big-endian length + pickle. Messages:
+  ("call",  req_id, method, args)   client -> server
+  ("reply", req_id, ok, payload)    server -> client
+  ("oneway", method, args)          client -> server, no reply
+  ("push",  topic, payload)         server -> client, no reply
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import queue
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">Q")
+
+
+def _send_frame(sock: socket.socket, obj, lock: Optional[threading.Lock]
+                ) -> None:
+    data = pickle.dumps(obj, protocol=5)
+    frame = _LEN.pack(len(data)) + data
+    if lock is not None:
+        with lock:
+            sock.sendall(frame)
+    else:
+        sock.sendall(frame)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket):
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, length))
+
+
+class RpcError(Exception):
+    """Remote handler raised; carries the remote exception."""
+
+
+class ConnectionContext:
+    """Server-side handle for one client connection; handlers may keep
+    it to push messages later (completion callbacks, pubsub)."""
+
+    def __init__(self, sock: socket.socket, peer):
+        self._sock = sock
+        self._send_lock = threading.Lock()
+        self.peer = peer
+        self.alive = True
+        self.meta: Dict[str, Any] = {}   # handler scratch (e.g. node id)
+
+    def push(self, topic: str, payload) -> bool:
+        try:
+            _send_frame(self._sock, ("push", topic, payload),
+                        self._send_lock)
+            return True
+        except OSError:
+            self.alive = False
+            return False
+
+
+class RpcServer:
+    """Threaded RPC server. ``register(name, fn)`` exposes
+    ``fn(ctx, *args)``; exceptions flow back to the caller as RpcError.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._handlers: Dict[str, Callable] = {}
+        self._disconnect_cb: Optional[Callable[[ConnectionContext], None]] \
+            = None
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):  # noqa: ANN201
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                ctx = ConnectionContext(sock, self.client_address)
+                try:
+                    while True:
+                        msg = _recv_frame(sock)
+                        outer._dispatch(ctx, msg)
+                except (ConnectionError, OSError, EOFError):
+                    pass
+                finally:
+                    ctx.alive = False
+                    if outer._disconnect_cb is not None:
+                        try:
+                            outer._disconnect_cb(ctx)
+                        except Exception:
+                            logger.exception("disconnect callback failed")
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, port), _Handler)
+        self.address: Tuple[str, int] = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True, name=f"rtpu-rpc-{self.address[1]}")
+        self._thread.start()
+
+    def register(self, name: str, fn: Callable) -> None:
+        self._handlers[name] = fn
+
+    def on_disconnect(self, cb: Callable[[ConnectionContext], None]) -> None:
+        self._disconnect_cb = cb
+
+    def _dispatch(self, ctx: ConnectionContext, msg) -> None:
+        kind = msg[0]
+        if kind == "call":
+            _, req_id, method, args = msg
+            fn = self._handlers.get(method)
+            if fn is None:
+                reply = ("reply", req_id, False,
+                         f"unknown method {method!r}")
+            else:
+                try:
+                    reply = ("reply", req_id, True, fn(ctx, *args))
+                except Exception as e:  # noqa: BLE001 - ships to caller
+                    logger.debug("handler %s raised", method, exc_info=True)
+                    reply = ("reply", req_id, False, e)
+            _send_frame(ctx._sock, reply, ctx._send_lock)
+        elif kind == "oneway":
+            _, method, args = msg
+            fn = self._handlers.get(method)
+            if fn is not None:
+                try:
+                    fn(ctx, *args)
+                except Exception:
+                    logger.exception("oneway handler %s failed", method)
+        else:
+            logger.warning("unknown rpc message kind %r", kind)
+
+    def shutdown(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+
+
+class RpcClient:
+    """Connection to an RpcServer: sync ``call``, fire-and-forget
+    ``oneway``, and a push callback for server-initiated messages."""
+
+    def __init__(self, address: Tuple[str, int],
+                 on_push: Optional[Callable[[str, Any], None]] = None,
+                 connect_timeout: float = 10.0,
+                 on_close: Optional[Callable[[], None]] = None):
+        self.address = tuple(address)
+        self._on_push = on_push
+        self._on_close = on_close
+        self._sock = socket.create_connection(self.address,
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._pending: Dict[int, queue.Queue] = {}
+        self._pending_lock = threading.Lock()
+        self._req_counter = 0
+        self.alive = True
+        self._closed_reason: Optional[BaseException] = None
+        # Pushes dispatch on their own thread, NOT the reader: a push
+        # handler is allowed to issue blocking call()s on this same
+        # client, and those replies can only be read by the reader —
+        # running handlers there would self-deadlock.
+        self._push_queue: queue.Queue = queue.Queue()
+        if on_push is not None:
+            self._push_thread = threading.Thread(
+                target=self._push_loop, daemon=True,
+                name=f"rtpu-rpc-push-{self.address[1]}")
+            self._push_thread.start()
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"rtpu-rpc-client-{self.address[1]}")
+        self._reader.start()
+
+    def _push_loop(self) -> None:
+        while True:
+            item = self._push_queue.get()
+            if item is None:
+                return
+            topic, payload = item
+            try:
+                self._on_push(topic, payload)
+            except Exception:
+                logger.exception("push callback failed")
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = _recv_frame(self._sock)
+                if msg[0] == "reply":
+                    _, req_id, ok, payload = msg
+                    with self._pending_lock:
+                        waiter = self._pending.pop(req_id, None)
+                    if waiter is not None:
+                        waiter.put((ok, payload))
+                elif msg[0] == "push":
+                    _, topic, payload = msg
+                    if self._on_push is not None:
+                        self._push_queue.put((topic, payload))
+        except (ConnectionError, OSError, EOFError) as e:
+            self._closed_reason = e
+        finally:
+            self.alive = False
+            with self._pending_lock:
+                pending = list(self._pending.values())
+                self._pending.clear()
+            for waiter in pending:
+                waiter.put((False, ConnectionError("connection lost")))
+            self._push_queue.put(None)
+            if self._on_close is not None:
+                try:
+                    self._on_close()
+                except Exception:
+                    logger.exception("rpc on_close callback failed")
+
+    def call(self, method: str, *args,
+             timeout: Optional[float] = None):
+        if not self.alive:
+            raise ConnectionError("rpc connection closed")
+        with self._pending_lock:
+            self._req_counter += 1
+            req_id = self._req_counter
+            waiter: queue.Queue = queue.Queue(maxsize=1)
+            self._pending[req_id] = waiter
+        _send_frame(self._sock, ("call", req_id, method, args),
+                    self._send_lock)
+        try:
+            ok, payload = waiter.get(timeout=timeout)
+        except queue.Empty:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise TimeoutError(
+                f"rpc call {method!r} timed out after {timeout}s") from None
+        if ok:
+            return payload
+        if isinstance(payload, BaseException):
+            raise RpcError(str(payload)) from payload
+        raise RpcError(str(payload))
+
+    def oneway(self, method: str, *args) -> None:
+        _send_frame(self._sock, ("oneway", method, args), self._send_lock)
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self._sock.close()
+        except Exception:
+            pass
+
+
+def wait_for_server(address: Tuple[str, int], timeout: float = 10.0) -> None:
+    """Block until a server accepts connections at ``address``."""
+    deadline = time.monotonic() + timeout
+    last: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            sock = socket.create_connection(tuple(address), timeout=1.0)
+            sock.close()
+            return
+        except OSError as e:
+            last = e
+            time.sleep(0.05)
+    raise TimeoutError(f"no rpc server at {address}: {last}")

@@ -105,9 +105,11 @@ class TaskManager:
                 rec.status = "finished"
                 self.num_finished += 1
                 self._release_args(rec.spec)
+                kind_map = {"inline": "blob", "shm": "shm",
+                            "remote": "remote"}
                 for oid_b, kind, data, contained in results:
                     entry = Entry(
-                        "blob" if kind == "inline" else "shm", data,
+                        kind_map[kind], data,
                         tuple(ObjectID(c) for c in contained))
                     self._store_result(ObjectID(oid_b), entry)
                 return

@@ -98,7 +98,16 @@ class Worker:
         from ray_tpu._private.device_object import DeviceStore
         self.device_store = DeviceStore()
         self.reference_counter = ReferenceCounter(self._on_ref_zero)
-        self.gcs = GcsLite()
+        self._gcs_proc = None
+        self.gcs_address = None
+        if cfg.gcs_mode == "process":
+            from ray_tpu._private.gcs_client import GcsClient
+            from ray_tpu._private.gcs_server import spawn_gcs_process
+            self._gcs_proc, self.gcs_address = spawn_gcs_process(
+                self.session, cfg.serialize())
+            self.gcs = GcsClient(self.gcs_address)
+        else:
+            self.gcs = GcsLite()
 
         self._functions: Dict[bytes, bytes] = {}   # fid -> cloudpickle blob
         self._functions_lock = threading.Lock()
@@ -295,6 +304,15 @@ class Worker:
             value = self.device_store.get(oid)
             if value is None:
                 raise _LostObjectSignal(oid)
+        elif entry.kind == "remote":
+            # Pull from the holding node into the local store (the
+            # entry mutates to shm), then read zero-copy.
+            if not self.node_group._localize_remote_entry(oid, entry):
+                raise _LostObjectSignal(oid)
+            blob = self.shm_store.get_local(oid)
+            if blob is None:
+                raise _LostObjectSignal(oid)
+            value, _ = self.serde.deserialize_from_blob(blob)
         else:  # shm
             blob = self.shm_store.get_local(oid)
             if blob is None:
@@ -738,6 +756,13 @@ class Worker:
                     raise _LostObjectSignal(arg.object_id)
                 arg_descs.append(
                     ("shm", arg.object_id.binary(), info[0], info[1]))
+            elif entry.kind == "remote":
+                # Resolved per destination by the node manager (pull
+                # descriptor for remote actors, localization for
+                # driver-process actors).
+                node_id, size = entry.data
+                arg_descs.append(
+                    ("remote", arg.object_id.binary(), node_id, size))
             else:
                 if not self.shm_store.contains(arg.object_id):
                     raise _LostObjectSignal(arg.object_id)
@@ -809,6 +834,17 @@ class Worker:
         self.node_group.shutdown()
         self.shm_store.shutdown()
         self.device_store.shutdown()
+        if self._gcs_proc is not None:
+            try:
+                self.gcs.close()
+            except Exception:
+                pass
+            try:
+                self._gcs_proc.terminate()
+                self._gcs_proc.wait(timeout=5)
+            except Exception:
+                pass
+            self._gcs_proc = None
 
     def cluster_resources(self) -> Dict[str, float]:
         total: Dict[str, float] = {}

@@ -1,16 +1,27 @@
-"""Cluster test utility: N logical nodes on one machine.
+"""Cluster test utility: N nodes on one machine.
 
 Reference: ``python/ray/cluster_utils.py`` (``Cluster`` spins up N real
 raylets as local processes with fake resources) [UNVERIFIED — mount
-empty, SURVEY.md §0]. Here a node = a `Raylet` object with its own
-worker pool and resource ledger inside the host process; the scheduler
-treats them exactly like remote nodes (SURVEY.md §4 implication).
+empty, SURVEY.md §0]. Two node substrates:
+
+- **logical** (default): a ``Raylet`` object with its own worker pool
+  and resource ledger inside the host process — cheap, full actor/PG
+  support, used by most tests;
+- **remote** (``add_node(remote=True)``): a real raylet *process*
+  (``raylet_server.py``) with its own object store, worker pool, and
+  wire channels — the distributed plane. Objects cross nodes only via
+  chunked transfer; a standalone GCS process health-checks the node.
+
+The scheduler sees both through the same ``ClusterResourceManager``
+seam, so the policy layer (including the TPU kernel policy) cannot
+tell the difference.
 """
 
 from __future__ import annotations
 
 from typing import Dict, Optional
 
+from ray_tpu._private.config import get_config
 from ray_tpu._private.gcs import NodeInfo
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.scheduler.resources import NodeResources
@@ -20,27 +31,85 @@ from ray_tpu._private.worker import Worker, global_worker, init, shutdown
 class Cluster:
     def __init__(self, head_num_cpus: float = 4,
                  head_resources: Optional[Dict[str, float]] = None,
+                 start_gcs: bool = False,
                  **kwargs):
         self._worker: Worker = init(num_cpus=head_num_cpus,
                                     resources=head_resources, **kwargs)
         self.head_node_id = self._worker.node_group.head_node_id
+        self._gcs_proc = None
+        self._gcs_addr = None
+        self._gcs_client = None
+        self._node_seq = 0
+        if start_gcs:
+            self._ensure_gcs()
+
+    # -- standalone GCS process ----------------------------------------
+
+    def _ensure_gcs(self):
+        if self._gcs_addr is not None:
+            return
+        if self._worker.gcs_address is not None:
+            # gcs_mode=process: the worker already runs a GCS process.
+            self._gcs_addr = self._worker.gcs_address
+            self._worker.gcs.publisher.subscribe("NODE",
+                                                 self._on_node_event)
+            return
+        from ray_tpu._private.gcs_client import GcsClient
+        from ray_tpu._private.gcs_server import spawn_gcs_process
+        self._gcs_proc, self._gcs_addr = spawn_gcs_process(
+            self._worker.session, get_config().serialize())
+        self._gcs_client = GcsClient(self._gcs_addr)
+        self._gcs_client.publisher.subscribe("NODE", self._on_node_event)
+
+    @property
+    def gcs_address(self):
+        return self._gcs_addr
+
+    @property
+    def gcs_client(self):
+        return self._gcs_client
+
+    def _on_node_event(self, msg) -> None:
+        """GCS health manager declared a node dead: tear it down."""
+        kind, payload = msg
+        if kind == "REMOVED":
+            self._worker.node_group._on_remote_node_lost(payload)
+
+    # -- membership ----------------------------------------------------
 
     def add_node(self, num_cpus: float = 4, num_tpus: float = 0,
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
-                 max_process_workers: int = 2) -> NodeID:
+                 max_process_workers: int = 2,
+                 remote: bool = False,
+                 object_store_memory: int = 0) -> NodeID:
         total = {"CPU": float(num_cpus)}
         if num_tpus:
             total["TPU"] = float(num_tpus)
         if resources:
             total.update({k: float(v) for k, v in resources.items()})
-        node_id = NodeID.from_random()
         w = self._worker
-        raylet = w.node_group.add_node(
-            node_id, NodeResources(total=dict(total),
-                                   available=dict(total)),
-            labels=labels)
-        raylet.worker_pool._max_process = max_process_workers
+        node_id = NodeID.from_random()
+        if remote:
+            self._ensure_gcs()
+            from ray_tpu._private.raylet_server import spawn_raylet_process
+            self._node_seq += 1
+            node_session = f"{w.session}n{self._node_seq}"
+            proc, addr = spawn_raylet_process(
+                node_session, node_id, total, gcs_addr=self._gcs_addr,
+                max_process_workers=max_process_workers, labels=labels,
+                object_store_memory=object_store_memory)
+            w.node_group.add_remote_node(
+                node_id, addr,
+                NodeResources(total=dict(total), available=dict(total),
+                              labels=dict(labels or {})),
+                proc=proc)
+        else:
+            raylet = w.node_group.add_node(
+                node_id, NodeResources(total=dict(total),
+                                       available=dict(total)),
+                labels=labels)
+            raylet.worker_pool._max_process = max_process_workers
         w.gcs.register_node(NodeInfo(node_id=node_id,
                                      resources_total=dict(total),
                                      labels=labels or {}))
@@ -48,12 +117,34 @@ class Cluster:
         return node_id
 
     def remove_node(self, node_id: NodeID) -> None:
-        self._worker.node_group.remove_node(node_id)
+        ng = self._worker.node_group
+        if node_id in ng._remote_nodes:
+            ng.remove_remote_node(node_id)
+        else:
+            ng.remove_node(node_id)
         self._worker.gcs.remove_node(node_id)
+
+    def kill_raylet_process(self, node_id: NodeID) -> None:
+        """Hard-kill a remote raylet process (fault injection). Driver
+        notices via the broken channel / GCS health check."""
+        handle = self._worker.node_group._remote_nodes.get(node_id)
+        if handle is not None and handle.proc is not None:
+            handle.proc.kill()
 
     @property
     def worker(self) -> Worker:
         return self._worker
 
     def shutdown(self) -> None:
+        if self._gcs_client is not None:
+            self._gcs_client.close()
+            self._gcs_client = None
         shutdown()
+        if self._gcs_proc is not None:
+            try:
+                self._gcs_proc.terminate()
+                self._gcs_proc.wait(timeout=5)
+            except Exception:
+                pass
+            self._gcs_proc = None
+            self._gcs_addr = None

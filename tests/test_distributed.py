@@ -1,0 +1,276 @@
+"""Distributed-plane tests: wire RPC, standalone GCS process, raylet
+processes, chunked cross-node object transfer, and failure recovery.
+
+Reference analogs: ``python/ray/tests/test_multi_node*.py``,
+``test_object_manager.py``, ``test_gcs_fault_tolerance.py`` [UNVERIFIED
+— mount empty, SURVEY.md §0]. Like the reference's test clusters, the
+"nodes" are raylet processes on one machine with fake resource shapes;
+objects cross nodes only through the transfer plane.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.rpc import RpcClient, RpcError, RpcServer
+
+BIG = 200_000   # float64 elements ≈ 1.6MB > inline cap
+
+
+# ---------------------------------------------------------------------------
+# RPC layer
+
+
+def test_rpc_call_oneway_push_error():
+    server = RpcServer()
+    got = []
+
+    def echo(ctx, x):
+        return x * 2
+
+    def boom(ctx):
+        raise ValueError("nope")
+
+    def subscribe(ctx):
+        ctx.push("news", "hello")
+        return "subscribed"
+
+    server.register("echo", echo)
+    server.register("boom", boom)
+    server.register("note", lambda ctx, m: got.append(m))
+    server.register("subscribe", subscribe)
+
+    pushes = []
+    client = RpcClient(server.address,
+                       on_push=lambda t, p: pushes.append((t, p)))
+    assert client.call("echo", 21) == 42
+    with pytest.raises(RpcError, match="nope"):
+        client.call("boom")
+    client.oneway("note", "fire-and-forget")
+    assert client.call("subscribe") == "subscribed"
+    deadline = time.monotonic() + 5
+    while (not pushes or not got) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert pushes == [("news", "hello")]
+    assert got == ["fire-and-forget"]
+    client.close()
+    server.shutdown()
+
+
+def test_rpc_large_payload_roundtrip():
+    server = RpcServer()
+    server.register("echo_len", lambda ctx, b: len(b))
+    client = RpcClient(server.address)
+    blob = b"x" * (8 * 1024 * 1024)
+    assert client.call("echo_len", blob) == len(blob)
+    client.close()
+    server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# GCS server process
+
+
+def test_gcs_process_roundtrip_and_pubsub():
+    from ray_tpu._private.gcs import NodeInfo
+    from ray_tpu._private.gcs_client import GcsClient
+    from ray_tpu._private.gcs_server import spawn_gcs_process
+
+    proc, addr = spawn_gcs_process("gcstest" + str(time.time_ns() % 10_000))
+    try:
+        c1 = GcsClient(addr)
+        c2 = GcsClient(addr)
+        events = []
+        c2.publisher.subscribe("NODE", events.append)
+
+        nid = NodeID.from_random()
+        c1.register_node(NodeInfo(node_id=nid,
+                                  resources_total={"CPU": 4.0}))
+        deadline = time.monotonic() + 5
+        while not events and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert events and events[0][0] == "ADDED"
+        assert [n.node_id for n in c2.get_all_node_info()] == [nid]
+
+        c1.kv_put(b"k", b"v", "ns")
+        assert c2.kv_get(b"k", "ns") == b"v"
+        assert c2.kv_keys(b"", "ns") == [b"k"]
+        assert c1.next_job_id() == 1
+        assert c2.next_job_id() == 2
+        c1.close()
+        c2.close()
+    finally:
+        proc.terminate()
+
+
+def test_gcs_health_check_declares_silent_node_dead():
+    """A node registered with an unreachable RPC address is declared
+    dead after health_check_failure_threshold missed pings."""
+    from ray_tpu._private.gcs import NodeInfo
+    from ray_tpu._private.gcs_server import GcsServer
+    from ray_tpu._private.config import get_config
+
+    cfg = get_config()
+    cfg.apply_system_config({"health_check_period_ms": 100,
+                             "health_check_failure_threshold": 2})
+    try:
+        server = GcsServer()
+        events = []
+        server.state.publisher.subscribe("NODE", events.append)
+        nid = NodeID.from_random()
+        # port 1 on localhost: connection refused -> ping failure
+        server._register_node(None, NodeInfo(node_id=nid,
+                                             resources_total={"CPU": 1.0}),
+                              ("127.0.0.1", 1))
+        deadline = time.monotonic() + 10
+        removed = False
+        while time.monotonic() < deadline:
+            if any(e[0] == "REMOVED" for e in events):
+                removed = True
+                break
+            time.sleep(0.05)
+        assert removed, f"node never declared dead; events={events}"
+        infos = {n.node_id: n for n in server.state.get_all_node_info()}
+        assert not infos[nid].alive
+        server.shutdown()
+    finally:
+        cfg.reset()
+
+
+def test_gcs_process_mode_end_to_end():
+    """gcs_mode=process: the whole driver runtime (actor registry,
+    named lookup) runs against the standalone GCS process."""
+    w = ray_tpu.init(num_cpus=4, max_process_workers=2,
+                     _system_config={"gcs_mode": "process"})
+    try:
+        from ray_tpu._private.gcs_client import GcsClient
+        assert isinstance(w.gcs, GcsClient)
+
+        @ray_tpu.remote
+        class Greeter:
+            def hi(self):
+                return "hi"
+
+        a = Greeter.options(name="greeter").remote()
+        assert ray_tpu.get(a.hi.remote()) == "hi"
+        b = ray_tpu.get_actor("greeter")
+        assert ray_tpu.get(b.hi.remote()) == "hi"
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# raylet processes end-to-end
+
+
+def test_remote_raylet_runs_tasks(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"R": 2}, remote=True)
+
+    @ray_tpu.remote(num_cpus=1, resources={"R": 1})
+    def f(a, b):
+        import os
+        return a + b, os.getpid()
+
+    import os
+    results = ray_tpu.get([f.remote(i, i) for i in range(4)])
+    assert [r[0] for r in results] == [0, 2, 4, 6]
+    # executed in the raylet's worker processes, not the driver's
+    assert all(r[1] != os.getpid() for r in results)
+
+
+def test_cross_node_object_transfer(ray_start_cluster):
+    """An object created on node A is consumed on node B via the
+    chunked transfer plane (and by the driver via pull)."""
+    cluster = ray_start_cluster
+    a = cluster.add_node(num_cpus=2, resources={"A": 2}, remote=True)
+    b = cluster.add_node(num_cpus=2, resources={"B": 2}, remote=True)
+
+    @ray_tpu.remote(num_cpus=1, resources={"A": 1})
+    def make():
+        return np.arange(BIG, dtype=np.float64)
+
+    @ray_tpu.remote(num_cpus=1, resources={"B": 1})
+    def consume(x):
+        return float(x.sum())
+
+    ref = make.remote()
+    out = ray_tpu.get(consume.remote(ref))
+    assert out == pytest.approx(float(np.arange(BIG,
+                                                dtype=np.float64).sum()))
+    # node B pulled the object over the wire
+    handle_b = cluster.worker.node_group._remote_nodes[b]
+    stats = handle_b.client.call("stats")
+    assert stats["num_pulled"] >= 1
+    # the driver can pull it too
+    val = ray_tpu.get(ref)
+    assert val.shape == (BIG,)
+    assert val[1] == 1.0
+
+
+def test_kill_raylet_midrun_tasks_retry_on_survivors(ray_start_cluster):
+    cluster = ray_start_cluster
+    doomed = cluster.add_node(num_cpus=2, resources={"S": 2}, remote=True)
+
+    @ray_tpu.remote(num_cpus=1, resources={"S": 1}, max_retries=3)
+    def slow(i):
+        import time as t
+        t.sleep(1.5)
+        return i * 10
+
+    refs = [slow.remote(i) for i in range(2)]
+    time.sleep(0.8)              # let them start on the doomed node
+    cluster.kill_raylet_process(doomed)
+    # survivors provide the resource after a moment
+    cluster.add_node(num_cpus=2, resources={"S": 2}, remote=True)
+    cluster.worker.node_group.recheck_infeasible()
+    assert sorted(ray_tpu.get(refs, timeout=60)) == [0, 10]
+
+
+def test_lost_remote_object_reconstructs(ray_start_cluster):
+    """Node death loses its objects; get() transparently re-executes
+    the creating task on survivors (lineage over the transfer plane)."""
+    cluster = ray_start_cluster
+    doomed = cluster.add_node(num_cpus=2, resources={"L": 2}, remote=True)
+
+    @ray_tpu.remote(num_cpus=1, resources={"L": 1})
+    def make(i):
+        return np.full(BIG, i, dtype=np.float64)
+
+    refs = [make.remote(i) for i in range(2)]
+    ray_tpu.wait(refs, num_returns=2, timeout=60)
+    cluster.kill_raylet_process(doomed)
+    time.sleep(0.5)
+    cluster.add_node(num_cpus=2, resources={"L": 2}, remote=True)
+    cluster.worker.node_group.recheck_infeasible()
+    for i, ref in enumerate(refs):
+        val = ray_tpu.get(ref)
+        assert val[0] == float(i)
+    assert cluster.worker.task_manager.num_reconstructions >= 1
+
+
+def test_remote_actor_lifecycle(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"ACT": 1}, remote=True)
+
+    @ray_tpu.remote(num_cpus=1, resources={"ACT": 1})
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, k):
+            self.v += k
+            return self.v
+
+        def big(self):
+            return np.ones(BIG)
+
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.add.remote(1)) == 101
+    assert ray_tpu.get(c.add.remote(2)) == 103
+    # big actor result stays remote until pulled
+    assert ray_tpu.get(c.big.remote()).shape == (BIG,)
+    ray_tpu.kill(c)
