@@ -1,0 +1,197 @@
+"""ray_tpu.serve — model serving on the core actor API.
+
+Reference: ``python/ray/serve/`` [UNVERIFIED — mount empty, SURVEY.md
+§0]: ``@serve.deployment`` classes/functions, ``serve.run`` deploying
+them, a controller reconciling target vs actual replica actors, a
+power-of-two-choices router over replica queue lengths, deployment
+handles, request-based autoscaling, and HTTP ingress.
+
+TPU-native notes: replicas are ordinary actors, so a deployment
+wrapping a jax model jit-compiles in its replica and serves the
+compiled program (the flagship use: batched transformer forward on the
+chip). The controller is a driver-side loop (this runtime's workers
+are pure executors; all library control planes live with the driver —
+same topology as Tune's controller).
+
+Usage::
+
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __call__(self, x):
+            return ...
+
+    handle = serve.run(Model.bind())
+    ref = handle.remote(x)
+    result = ray_tpu.get(ref)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional, Union
+
+from ray_tpu.serve._private.controller import (
+    AutoscalingConfig,
+    ServeController,
+)
+
+__all__ = [
+    "deployment", "run", "delete", "get_deployment_handle", "start",
+    "shutdown", "status", "http_address", "AutoscalingConfig",
+    "Deployment", "DeploymentHandle",
+]
+
+_controller: Optional[ServeController] = None
+_proxy = None
+_lock = threading.Lock()
+
+
+def _get_controller(start_http: bool = False) -> ServeController:
+    global _controller, _proxy
+    with _lock:
+        if _controller is None:
+            import ray_tpu
+            ray_tpu.init()
+            _controller = ServeController()
+        if start_http and _proxy is None:
+            from ray_tpu.serve._private.http_proxy import HttpProxy
+            _proxy = HttpProxy(_controller)
+        return _controller
+
+
+class DeploymentHandle:
+    """Client handle: routes calls through the deployment's router."""
+
+    def __init__(self, name: str, replica_set):
+        self.deployment_name = name
+        self._replica_set = replica_set
+
+    def remote(self, *args, **kwargs):
+        return self._replica_set.assign("__call__", args, kwargs)
+
+    def method(self, method_name: str):
+        handle = self
+
+        class _Method:
+            def remote(self, *args, **kwargs):
+                return handle._replica_set.assign(method_name, args,
+                                                  kwargs)
+
+        return _Method()
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return self.method(item)
+
+
+class Application:
+    """A bound deployment (deployment + init args), ready to run."""
+
+    def __init__(self, deployment: "Deployment", args: tuple,
+                 kwargs: dict):
+        self.deployment = deployment
+        self.init_args = args
+        self.init_kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, target: Union[type, Callable], name: str,
+                 num_replicas: int, ray_actor_options: Optional[dict],
+                 autoscaling_config: Optional[dict]):
+        self._target = target
+        self.name = name
+        self.num_replicas = num_replicas
+        self.ray_actor_options = dict(ray_actor_options or {})
+        self.autoscaling_config = autoscaling_config
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[int] = None,
+                ray_actor_options: Optional[dict] = None,
+                autoscaling_config: Optional[dict] = None) -> "Deployment":
+        return Deployment(
+            self._target,
+            name if name is not None else self.name,
+            num_replicas if num_replicas is not None else self.num_replicas,
+            ray_actor_options if ray_actor_options is not None
+            else self.ray_actor_options,
+            autoscaling_config if autoscaling_config is not None
+            else self.autoscaling_config)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+
+def deployment(_target=None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               ray_actor_options: Optional[dict] = None,
+               autoscaling_config: Optional[dict] = None):
+    """``@serve.deployment`` decorator for classes and functions."""
+
+    def wrap(target):
+        return Deployment(target, name or target.__name__, num_replicas,
+                          ray_actor_options, autoscaling_config)
+
+    if _target is not None:
+        return wrap(_target)
+    return wrap
+
+
+def run(app: Union[Application, Deployment], *, name: Optional[str] = None,
+        wait_for_healthy: bool = True, timeout: float = 120.0
+        ) -> DeploymentHandle:
+    """Deploy (or redeploy) and return a handle."""
+    if isinstance(app, Deployment):
+        app = app.bind()
+    dep = app.deployment
+    controller = _get_controller()
+    autoscaling = None
+    if dep.autoscaling_config is not None:
+        cfg = dep.autoscaling_config
+        autoscaling = (cfg if isinstance(cfg, AutoscalingConfig)
+                       else AutoscalingConfig(**cfg))
+    dep_name = name or dep.name
+    replica_set = controller.deploy(
+        dep_name, dep._target, app.init_args, app.init_kwargs,
+        dep.num_replicas, actor_options=dep.ray_actor_options,
+        autoscaling=autoscaling)
+    if wait_for_healthy:
+        controller.wait_healthy(dep_name, timeout=timeout)
+    return DeploymentHandle(dep_name, replica_set)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    controller = _get_controller()
+    replica_set = controller.get_replica_set(name)
+    if replica_set is None:
+        raise ValueError(f"no deployment named {name!r}")
+    return DeploymentHandle(name, replica_set)
+
+
+def delete(name: str) -> None:
+    _get_controller().delete(name)
+
+
+def status() -> dict:
+    return _get_controller().status()
+
+
+def start(http: bool = True):
+    """Start serve (optionally with the HTTP ingress)."""
+    return _get_controller(start_http=http)
+
+
+def http_address():
+    _get_controller(start_http=True)
+    return _proxy.address
+
+
+def shutdown() -> None:
+    global _controller, _proxy
+    with _lock:
+        if _proxy is not None:
+            _proxy.shutdown()
+            _proxy = None
+        if _controller is not None:
+            _controller.shutdown()
+            _controller = None

@@ -1,0 +1,238 @@
+"""Serve controller: reconciles target vs actual replicas.
+
+Reference: ``python/ray/serve/_private/controller.py`` +
+``deployment_state.py`` [UNVERIFIED — mount empty, SURVEY.md §0]: a
+control loop owning the deployment table; every iteration it converges
+each deployment's actual replica set toward the target (create
+missing, remove extra, replace dead) and applies request-based
+autoscaling. The reference hosts this in a detached actor; here it is
+a driver-side controller thread (the same topology as this framework's
+Tune controller — this runtime's workers are pure executors, so
+control loops live with the driver). Replicas themselves are ordinary
+core-API actors — libraries-on-core holds.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve._private.replica import ReplicaActor
+from ray_tpu.serve._private.router import ReplicaSet
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclass
+class DeploymentInfo:
+    name: str
+    deployment_blob: bytes
+    init_args: tuple
+    init_kwargs: dict
+    num_replicas: int
+    actor_options: dict = field(default_factory=dict)
+    autoscaling: Optional[AutoscalingConfig] = None
+    replicas: List = field(default_factory=list)
+    replica_set: ReplicaSet = None
+    state: str = "DEPLOYING"     # DEPLOYING|HEALTHY|DELETING
+    _last_scale_change: float = 0.0
+    _scale_pressure_since: Optional[float] = None
+
+
+class ServeController:
+    """Driver-side reconcile loop over the deployment table."""
+
+    RECONCILE_PERIOD_S = 0.25
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._deployments: Dict[str, DeploymentInfo] = {}
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rtpu-serve-controller")
+        self._thread.start()
+
+    # -- API -----------------------------------------------------------
+
+    def deploy(self, name: str, target, init_args: tuple,
+               init_kwargs: dict, num_replicas: int,
+               actor_options: Optional[dict] = None,
+               autoscaling: Optional[AutoscalingConfig] = None
+               ) -> ReplicaSet:
+        info = DeploymentInfo(
+            name=name,
+            deployment_blob=cloudpickle.dumps(target),
+            init_args=init_args, init_kwargs=init_kwargs,
+            num_replicas=num_replicas,
+            actor_options=dict(actor_options or {}),
+            autoscaling=autoscaling,
+            replica_set=ReplicaSet(name))
+        if autoscaling is not None:
+            info.num_replicas = max(autoscaling.min_replicas,
+                                    min(num_replicas,
+                                        autoscaling.max_replicas))
+        with self._lock:
+            old = self._deployments.get(name)
+            if old is not None:
+                info.replica_set = old.replica_set   # handles stay valid
+                self._kill_replicas(old.replicas)
+            self._deployments[name] = info
+        self._reconcile_once()
+        return info.replica_set
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            info = self._deployments.pop(name, None)
+        if info is not None:
+            self._kill_replicas(info.replicas)
+            info.replica_set.set_replicas([])
+
+    def get_replica_set(self, name: str) -> Optional[ReplicaSet]:
+        with self._lock:
+            info = self._deployments.get(name)
+            return info.replica_set if info else None
+
+    def status(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                name: {
+                    "state": info.state,
+                    "target_replicas": info.num_replicas,
+                    "live_replicas": len(info.replicas),
+                    "ongoing_requests": info.replica_set.total_inflight(),
+                }
+                for name, info in self._deployments.items()
+            }
+
+    def wait_healthy(self, name: str, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                info = self._deployments.get(name)
+                if info is not None and info.state == "HEALTHY":
+                    return
+            time.sleep(0.05)
+        raise TimeoutError(f"deployment {name!r} never became healthy")
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        with self._lock:
+            names = list(self._deployments)
+        for name in names:
+            self.delete(name)
+
+    # -- reconcile loop ------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._shutdown.wait(self.RECONCILE_PERIOD_S):
+            try:
+                self._reconcile_once()
+            except Exception:
+                logger.exception("serve reconcile error")
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            infos = list(self._deployments.values())
+        for info in infos:
+            self._reconcile_deployment(info)
+
+    def _reconcile_deployment(self, info: DeploymentInfo) -> None:
+        # 1. drop dead replicas (replica-death recovery)
+        live = []
+        for handle in info.replicas:
+            if self._replica_alive(handle):
+                live.append(handle)
+            else:
+                logger.warning("serve %s: replica died; replacing",
+                               info.name)
+        info.replicas = live
+
+        # 2. autoscale on ongoing requests
+        if info.autoscaling is not None:
+            self._autoscale(info)
+
+        # 3. converge toward target
+        while len(info.replicas) < info.num_replicas:
+            handle = self._create_replica(info)
+            if handle is None:
+                break
+            info.replicas.append(handle)
+        while len(info.replicas) > info.num_replicas:
+            victim = info.replicas.pop()
+            self._kill_replicas([victim])
+
+        info.replica_set.set_replicas(info.replicas)
+        info.state = ("HEALTHY"
+                      if len(info.replicas) >= max(1, info.num_replicas)
+                      else "DEPLOYING")
+
+    def _autoscale(self, info: DeploymentInfo) -> None:
+        cfg = info.autoscaling
+        ongoing = info.replica_set.total_inflight()
+        current = max(len(info.replicas), 1)
+        per_replica = ongoing / current
+        now = time.monotonic()
+        want = info.num_replicas
+        if per_replica > cfg.target_ongoing_requests:
+            if info._scale_pressure_since is None:
+                info._scale_pressure_since = now
+            if now - info._scale_pressure_since >= cfg.upscale_delay_s:
+                want = min(current + 1, cfg.max_replicas)
+        elif per_replica < cfg.target_ongoing_requests * 0.5:
+            if info._scale_pressure_since is None:
+                info._scale_pressure_since = now
+            if now - info._scale_pressure_since >= cfg.downscale_delay_s:
+                want = max(current - 1, cfg.min_replicas)
+        else:
+            info._scale_pressure_since = None
+        if want != info.num_replicas:
+            logger.info("serve %s: autoscale %d -> %d (ongoing=%d)",
+                        info.name, info.num_replicas, want, ongoing)
+            info.num_replicas = want
+            info._scale_pressure_since = None
+
+    # -- replica lifecycle ---------------------------------------------
+
+    def _create_replica(self, info: DeploymentInfo):
+        try:
+            actor_cls = ray_tpu.remote(ReplicaActor)
+            opts = dict(info.actor_options)
+            opts.setdefault("max_restarts", 0)
+            handle = actor_cls.options(**opts).remote(
+                info.deployment_blob, info.init_args, info.init_kwargs)
+            # wait for construction so state flips once it's servable
+            ray_tpu.get(handle.ping.remote(), timeout=120)
+            return handle
+        except Exception:
+            logger.exception("serve %s: replica creation failed",
+                             info.name)
+            return None
+
+    @staticmethod
+    def _replica_alive(handle) -> bool:
+        from ray_tpu._private.worker import global_worker
+        info = global_worker().gcs.get_actor_info(handle._actor_id)
+        return info is not None and info.state == "ALIVE"
+
+    @staticmethod
+    def _kill_replicas(handles) -> None:
+        for handle in handles:
+            try:
+                ray_tpu.kill(handle)
+            except Exception:
+                pass

@@ -1,0 +1,85 @@
+"""Minimal HTTP ingress.
+
+Reference: ``python/ray/serve/_private/proxy.py`` (uvicorn/starlette
+proxy actors) [UNVERIFIED — mount empty, SURVEY.md §0]. A threaded
+stdlib HTTP server in the driver process: ``POST /<deployment>`` with a
+JSON (or raw bytes) body routes through the deployment's pow-2 router
+and returns the result. Enough ingress to exercise real HTTP routing
+in tests without external deps.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+class HttpProxy:
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0):
+        self._controller = controller
+        proxy = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: ANN002 - silence stdlib
+                pass
+
+            def do_POST(self):  # noqa: N802 - stdlib naming
+                name = self.path.strip("/").split("/")[0]
+                replica_set = proxy._controller.get_replica_set(name)
+                if replica_set is None:
+                    self.send_error(404, f"no deployment {name!r}")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                ctype = self.headers.get("Content-Type", "")
+                try:
+                    if "json" in ctype and body:
+                        payload = json.loads(body)
+                        args = (payload,)
+                    elif body:
+                        args = (body,)
+                    else:
+                        args = ()
+                    ref = replica_set.assign("__call__", args, {})
+                    result = ray_tpu.get(ref, timeout=120)
+                except Exception as e:  # noqa: BLE001 - surfaces as 500
+                    self.send_error(500, str(e)[:500])
+                    return
+                blob = json.dumps(result, default=str).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") in ("", "/-", "/-/routes"):
+                    blob = json.dumps(proxy._controller.status()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                else:
+                    self.do_POST()
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.address = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True, name="rtpu-serve-http")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass

@@ -1,0 +1,36 @@
+"""Replica actor: hosts one copy of a deployment.
+
+Reference: ``python/ray/serve/_private/replica.py`` [UNVERIFIED —
+mount empty, SURVEY.md §0]. A replica is a plain core-API actor (the
+libraries-on-core invariant): the controller creates N of them per
+deployment; the router fans requests over them. TPU-native angle: a
+replica wrapping a jax model jit-compiles once at construction and
+serves the compiled program from then on.
+"""
+
+from __future__ import annotations
+
+
+class ReplicaActor:
+    """Wraps the user's deployment class/function."""
+
+    def __init__(self, deployment_blob: bytes, init_args: tuple,
+                 init_kwargs: dict):
+        import cloudpickle
+        target = cloudpickle.loads(deployment_blob)
+        if isinstance(target, type):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            if init_args or init_kwargs:
+                raise TypeError("function deployments take no init args")
+            self._callable = target
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict):
+        if method in ("__call__", ""):
+            fn = self._callable
+        else:
+            fn = getattr(self._callable, method)
+        return fn(*args, **kwargs)
+
+    def ping(self) -> str:
+        return "pong"

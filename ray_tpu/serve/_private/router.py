@@ -1,0 +1,83 @@
+"""Request router: power-of-two-choices over replica queue lengths.
+
+Reference: ``python/ray/serve/_private/replica_scheduler/
+pow_2_scheduler.py`` + ``router.py`` [UNVERIFIED — mount empty,
+SURVEY.md §0]: sample two replicas, send to the one with the shorter
+queue. Queue length here is the router-tracked in-flight count per
+replica (incremented on assign, decremented when the result object
+resolves), the same client-side signal the reference's handle uses.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu._private.object_ref import ObjectRef
+
+
+class ReplicaSet:
+    """The router's view of one deployment's replicas + in-flight
+    accounting. Thread-safe; shared by handles and the controller."""
+
+    def __init__(self, deployment_name: str):
+        self.deployment_name = deployment_name
+        self._lock = threading.Lock()
+        self._replicas: List = []          # ActorHandle list
+        self._inflight: Dict[int, int] = {}  # id(handle) -> count
+        self._rng = random.Random(0xF00D)
+        self.total_assigned = 0
+
+    # -- membership (controller-driven) --------------------------------
+
+    def set_replicas(self, replicas: List) -> None:
+        with self._lock:
+            keep = {id(r) for r in replicas}
+            self._replicas = list(replicas)
+            self._inflight = {id(r): self._inflight.get(id(r), 0)
+                              for r in replicas}
+
+    def replicas(self) -> List:
+        with self._lock:
+            return list(self._replicas)
+
+    def num_replicas(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def total_inflight(self) -> int:
+        with self._lock:
+            return sum(self._inflight.values())
+
+    # -- assignment ----------------------------------------------------
+
+    def assign(self, method: str, args: tuple, kwargs: dict) -> ObjectRef:
+        with self._lock:
+            if not self._replicas:
+                raise RuntimeError(
+                    f"deployment {self.deployment_name!r} has no live "
+                    "replicas")
+            if len(self._replicas) == 1:
+                chosen = self._replicas[0]
+            else:
+                # power of two choices on tracked queue length
+                a, b = self._rng.sample(self._replicas, 2)
+                chosen = (a if self._inflight.get(id(a), 0)
+                          <= self._inflight.get(id(b), 0) else b)
+            self._inflight[id(chosen)] = \
+                self._inflight.get(id(chosen), 0) + 1
+            self.total_assigned += 1
+        ref = chosen.handle_request.remote(method, args, kwargs)
+        self._watch(ref, id(chosen))
+        return ref
+
+    def _watch(self, ref: ObjectRef, replica_key: int) -> None:
+        """Decrement in-flight when the result lands (ongoing-requests
+        signal for pow-2 and autoscaling)."""
+        def _done(_fut):
+            with self._lock:
+                if replica_key in self._inflight:
+                    self._inflight[replica_key] = max(
+                        0, self._inflight[replica_key] - 1)
+        ref.future().add_done_callback(_done)
