@@ -1,0 +1,18 @@
+"""ray_tpu.rl — reinforcement learning on the core API.
+
+Reference: ``rllib/`` [UNVERIFIED — mount empty, SURVEY.md §0]. The
+shape is RLlib's: an AlgorithmConfig builder, an algorithm driving an
+EnvRunnerGroup (CPU rollout actors) and a learner, vectorized envs, a
+placement-group resource gang. The learner is TPU-native: a single
+pjit data-parallel program over the device mesh instead of a DDP actor
+gang (see ``ppo.py``).
+"""
+
+from ray_tpu.rl.env import CartPoleVec, VectorEnv, make_env, register_env
+from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rl.ppo import PPO, PPOConfig, init_policy_params
+
+__all__ = [
+    "PPO", "PPOConfig", "EnvRunner", "EnvRunnerGroup", "VectorEnv",
+    "CartPoleVec", "make_env", "register_env", "init_policy_params",
+]

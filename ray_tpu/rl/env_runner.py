@@ -1,0 +1,110 @@
+"""EnvRunner actors: CPU-side experience collection.
+
+Reference: ``rllib/env/env_runner_group.py`` (née WorkerSet): rollout
+actors each stepping vectorized envs with the current policy, gathered
+by the algorithm each iteration [UNVERIFIED — mount empty, SURVEY.md
+§0].
+
+Heterogeneous resource shape by design: runners are ``num_cpus=1``
+actors doing numpy policy inference (no device dependency at all),
+while the learner holds the TPU mesh in the driver — the CPU-rollout /
+TPU-learner split the reference achieves with separate GPU/CPU actor
+resource requests.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.env import make_env
+
+
+def _policy_forward(params: Dict[str, np.ndarray], obs: np.ndarray
+                    ) -> np.ndarray:
+    """Numpy mirror of the learner's MLP policy head (logits only)."""
+    h = np.tanh(obs @ params["w1"] + params["b1"])
+    h = np.tanh(h @ params["w2"] + params["b2"])
+    return h @ params["wp"] + params["bp"]
+
+
+class EnvRunner:
+    """Actor: owns a vectorized env batch; collects fixed-length
+    rollouts with the shipped policy params."""
+
+    def __init__(self, env_name: str, num_envs: int, seed: int = 0):
+        self.env = make_env(env_name, num_envs, seed)
+        self.rng = np.random.RandomState(seed + 10_000)
+        self.obs = self.env.observe()
+
+    def collect(self, params: Dict[str, np.ndarray], rollout_len: int
+                ) -> Dict[str, np.ndarray]:
+        T, B = rollout_len, self.env.num_envs
+        obs_buf = np.empty((T, B, self.env.obs_dim), np.float32)
+        act_buf = np.empty((T, B), np.int32)
+        logp_buf = np.empty((T, B), np.float32)
+        rew_buf = np.empty((T, B), np.float32)
+        done_buf = np.empty((T, B), bool)
+        for t in range(T):
+            obs_buf[t] = self.obs
+            logits = _policy_forward(params, self.obs)
+            # Gumbel-max categorical sample + log-prob
+            z = logits - logits.max(axis=1, keepdims=True)
+            probs = np.exp(z)
+            probs /= probs.sum(axis=1, keepdims=True)
+            gumbel = -np.log(-np.log(
+                self.rng.uniform(1e-9, 1.0, logits.shape)))
+            actions = np.argmax(logits + gumbel, axis=1).astype(np.int32)
+            act_buf[t] = actions
+            logp_buf[t] = np.log(
+                probs[np.arange(B), actions] + 1e-9).astype(np.float32)
+            self.obs, rew_buf[t], done_buf[t] = self.env.step(actions)
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "rewards": rew_buf, "dones": done_buf,
+            "last_obs": self.obs.copy(),
+            "episode_returns": np.asarray(
+                self.env.drain_episode_returns(), np.float32),
+        }
+
+
+class EnvRunnerGroup:
+    """Gang of EnvRunner actors, optionally pinned to a placement
+    group's CPU bundles."""
+
+    def __init__(self, env_name: str, num_runners: int,
+                 num_envs_per_runner: int, seed: int = 0,
+                 placement_group=None, bundle_offset: int = 0):
+        actor_cls = ray_tpu.remote(EnvRunner)
+        self._runners = []
+        for i in range(num_runners):
+            opts: dict = {"num_cpus": 1}
+            if placement_group is not None:
+                from ray_tpu.util.scheduling_strategies import (
+                    PlacementGroupSchedulingStrategy)
+                opts["scheduling_strategy"] = \
+                    PlacementGroupSchedulingStrategy(
+                        placement_group,
+                        placement_group_bundle_index=bundle_offset + i)
+            self._runners.append(
+                actor_cls.options(**opts).remote(
+                    env_name, num_envs_per_runner, seed + i * 1000))
+
+    @property
+    def num_runners(self) -> int:
+        return len(self._runners)
+
+    def collect(self, params: Dict[str, np.ndarray], rollout_len: int
+                ) -> List[Dict[str, np.ndarray]]:
+        refs = [r.collect.remote(params, rollout_len)
+                for r in self._runners]
+        return ray_tpu.get(refs, timeout=300)
+
+    def shutdown(self) -> None:
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,350 @@
+"""PPO: CPU rollout actors + pjit data-parallel learner.
+
+Reference: ``rllib/algorithms/ppo/`` driving an EnvRunnerGroup and a
+LearnerGroup (DDP learner actors) [UNVERIFIED — mount empty, SURVEY.md
+§0]. TPU-native redesign:
+
+- experience collection stays on cheap CPU actors (numpy inference),
+- the learner is ONE pjit program over a ``dp`` device mesh in the
+  driver (the process that owns the chips): batch sharded over dp,
+  params replicated, gradient psum compiled into the program by XLA —
+  the reference's multi-process DDP gang collapses into a compiled
+  SPMD update,
+- GAE and the clipped-surrogate epochs run as a single jitted program
+  (lax.scan over epochs), so per-iteration device work is one launch.
+
+Resource gang: a placement group reserves one CPU bundle per runner
+plus a learner bundle (TPU when available) — RLlib's heterogeneous
+rollout/learner shape via gang scheduling.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import ray_tpu
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.env_runner import EnvRunnerGroup
+
+
+# --------------------------------------------------------------------------
+# policy/value network
+
+
+def init_policy_params(key, obs_dim: int, num_actions: int,
+                       hidden: int = 64) -> Dict[str, np.ndarray]:
+    k1, k2, k3, k4, k5, k6 = jax.random.split(key, 6)
+
+    def dense(k, fan_in, shape):
+        return np.asarray(jax.random.normal(k, shape) / np.sqrt(fan_in),
+                          np.float32)
+
+    # Separate policy/value trunks: the value target scale (episode
+    # returns, O(100)) would otherwise swamp the policy gradient
+    # through a shared trunk.
+    return {
+        "w1": dense(k1, obs_dim, (obs_dim, hidden)),
+        "b1": np.zeros(hidden, np.float32),
+        "w2": dense(k2, hidden, (hidden, hidden)),
+        "b2": np.zeros(hidden, np.float32),
+        "wp": dense(k3, hidden, (hidden, num_actions)) * 0.01,
+        "bp": np.zeros(num_actions, np.float32),
+        "vw1": dense(k4, obs_dim, (obs_dim, hidden)),
+        "vb1": np.zeros(hidden, np.float32),
+        "vw2": dense(k5, hidden, (hidden, hidden)),
+        "vb2": np.zeros(hidden, np.float32),
+        "wv": dense(k6, hidden, (hidden, 1)) * 0.1,
+        "bv": np.zeros(1, np.float32),
+    }
+
+
+def _net(params, obs):
+    h = jnp.tanh(obs @ params["w1"] + params["b1"])
+    h = jnp.tanh(h @ params["w2"] + params["b2"])
+    logits = h @ params["wp"] + params["bp"]
+    hv = jnp.tanh(obs @ params["vw1"] + params["vb1"])
+    hv = jnp.tanh(hv @ params["vw2"] + params["vb2"])
+    value = (hv @ params["wv"] + params["bv"])[..., 0]
+    return logits, value
+
+
+# --------------------------------------------------------------------------
+# config (AlgorithmConfig builder style)
+
+
+@dataclass
+class PPOConfig:
+    env: str = "CartPole"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 16
+    rollout_length: int = 128
+    lr: float = 3e-3
+    gamma: float = 0.99
+    lam: float = 0.95
+    clip: float = 0.2
+    epochs: int = 8
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    hidden: int = 64
+    seed: int = 0
+    learner_devices: Optional[int] = None   # None = all local devices
+    use_placement_group: bool = True
+    learner_resources: Dict[str, float] = field(default_factory=dict)
+
+    def environment(self, env: str) -> "PPOConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_runner: Optional[int] = None,
+                    rollout_length: Optional[int] = None) -> "PPOConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_runner is not None:
+            self.num_envs_per_runner = num_envs_per_runner
+        if rollout_length is not None:
+            self.rollout_length = rollout_length
+        return self
+
+    def training(self, **kw) -> "PPOConfig":
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise ValueError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def resources(self, *, learner_devices: Optional[int] = None,
+                  use_placement_group: Optional[bool] = None,
+                  learner_resources: Optional[Dict[str, float]] = None
+                  ) -> "PPOConfig":
+        if learner_devices is not None:
+            self.learner_devices = learner_devices
+        if use_placement_group is not None:
+            self.use_placement_group = use_placement_group
+        if learner_resources is not None:
+            self.learner_resources = dict(learner_resources)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+# --------------------------------------------------------------------------
+# the algorithm
+
+
+class PPO:
+    """Iterative trainer: ``train()`` = collect + one learner update.
+
+    Tune-compatible: train() returns a metrics dict; save()/restore()
+    round-trip params + optimizer state.
+    """
+
+    def __init__(self, config: PPOConfig):
+        self.config = config
+        ray_tpu.init()
+        probe = make_env(config.env, 1, 0)
+        self.obs_dim = probe.obs_dim
+        self.num_actions = probe.num_actions
+
+        self._pg = None
+        bundle_offset = 0
+        if config.use_placement_group:
+            from ray_tpu.util.placement_group import placement_group
+            learner_bundle = dict(config.learner_resources) or \
+                self._default_learner_bundle()
+            bundles = [learner_bundle] + \
+                [{"CPU": 1.0}] * config.num_env_runners
+            self._pg = placement_group(bundles, strategy="PACK")
+            ray_tpu.get(self._pg.ready(), timeout=120)
+            bundle_offset = 1
+        self.runners = EnvRunnerGroup(
+            config.env, config.num_env_runners, config.num_envs_per_runner,
+            seed=config.seed, placement_group=self._pg,
+            bundle_offset=bundle_offset)
+
+        self.params = init_policy_params(
+            jax.random.PRNGKey(config.seed), self.obs_dim,
+            self.num_actions, config.hidden)
+        self.opt_state = {k: np.zeros_like(v)
+                          for k, v in self.params.items()}  # adam m
+        self.opt_state_v = {k: np.zeros_like(v)
+                            for k, v in self.params.items()}  # adam v
+        self.iteration = 0
+        self._step_count = 0
+
+        n_dev = config.learner_devices or len(jax.devices())
+        total_envs = config.num_env_runners * config.num_envs_per_runner
+        while n_dev > 1 and total_envs % n_dev != 0:
+            n_dev -= 1
+        self.mesh = make_mesh(MeshSpec(dp=n_dev))
+        self._update = self._build_update()
+        self._recent_returns: List[float] = []
+
+    @staticmethod
+    def _default_learner_bundle() -> Dict[str, float]:
+        try:
+            avail = ray_tpu.cluster_resources()
+        except Exception:
+            avail = {}
+        if avail.get("TPU", 0) >= 1:
+            return {"TPU": min(8.0, avail["TPU"]), "CPU": 1.0}
+        return {"CPU": 1.0}
+
+    # -- jitted learner ------------------------------------------------
+
+    def _build_update(self):
+        cfg = self.config
+        mesh = self.mesh
+        batch_sharding = NamedSharding(mesh, P(None, "dp"))    # [T, B]
+        obs_sharding = NamedSharding(mesh, P(None, "dp", None))
+        rep = NamedSharding(mesh, P())
+
+        def loss_fn(params, obs, actions, old_logp, adv, ret):
+            logits, value = _net(params, obs)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, actions[..., None], axis=-1)[..., 0]
+            ratio = jnp.exp(logp - old_logp)
+            clipped = jnp.clip(ratio, 1 - cfg.clip, 1 + cfg.clip)
+            pg_loss = -jnp.mean(jnp.minimum(ratio * adv, clipped * adv))
+            vf_loss = jnp.mean((value - ret) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            return (pg_loss + cfg.vf_coeff * vf_loss
+                    - cfg.entropy_coeff * entropy), (pg_loss, vf_loss,
+                                                     entropy)
+
+        def adam(p, m, v, g, t):
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            m = jax.tree.map(lambda mi, gi: b1 * mi + (1 - b1) * gi, m, g)
+            v = jax.tree.map(lambda vi, gi: b2 * vi + (1 - b2) * gi ** 2,
+                             v, g)
+            mhat = jax.tree.map(lambda mi: mi / (1 - b1 ** t), m)
+            vhat = jax.tree.map(lambda vi: vi / (1 - b2 ** t), v)
+            p = jax.tree.map(
+                lambda pi, mi, vi: pi - cfg.lr * mi / (jnp.sqrt(vi) + eps),
+                p, mhat, vhat)
+            return p, m, v
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2),
+                 out_shardings=None)
+        def update(params, opt_m, opt_v, obs, actions, old_logp,
+                   rewards, dones, last_obs, t0):
+            # values for GAE (one extra bootstrap step)
+            _, values = _net(params, obs)                    # [T, B]
+            _, last_v = _net(params, last_obs)               # [B]
+            not_done = 1.0 - dones.astype(jnp.float32)
+
+            def gae_step(carry, xs):
+                adv_next, v_next = carry
+                r_t, v_t, nd_t = xs
+                delta = r_t + cfg.gamma * v_next * nd_t - v_t
+                adv_t = delta + cfg.gamma * cfg.lam * nd_t * adv_next
+                return (adv_t, v_t), adv_t
+
+            (_, _), adv = jax.lax.scan(
+                gae_step, (jnp.zeros_like(last_v), last_v),
+                (rewards, values, not_done), reverse=True)
+            ret = adv + values
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+            def epoch(carry, t):
+                params, m, v = carry
+                (l, aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, obs, actions,
+                                           old_logp, adv, ret)
+                params, m, v = adam(params, m, v, grads, t0 + t + 1)
+                return (params, m, v), l
+
+            (params, opt_m, opt_v), losses = jax.lax.scan(
+                epoch, (params, opt_m, opt_v), jnp.arange(cfg.epochs))
+            return params, opt_m, opt_v, losses[-1]
+
+        self._shardings = (obs_sharding, batch_sharding, rep)
+        return update
+
+    # -- Trainable API -------------------------------------------------
+
+    def train(self) -> Dict:
+        cfg = self.config
+        t_start = time.perf_counter()
+        rollouts = self.runners.collect(self.params, cfg.rollout_length)
+        obs = np.concatenate([r["obs"] for r in rollouts], axis=1)
+        actions = np.concatenate([r["actions"] for r in rollouts], axis=1)
+        logp = np.concatenate([r["logp"] for r in rollouts], axis=1)
+        rewards = np.concatenate([r["rewards"] for r in rollouts], axis=1)
+        dones = np.concatenate([r["dones"] for r in rollouts], axis=1)
+        last_obs = np.concatenate([r["last_obs"] for r in rollouts], axis=0)
+        for r in rollouts:
+            self._recent_returns.extend(r["episode_returns"].tolist())
+        self._recent_returns = self._recent_returns[-100:]
+
+        obs_sh, batch_sh, rep = self._shardings
+        dev = partial(jax.device_put)
+        out = self._update(
+            jax.device_put(self.params, rep),
+            jax.device_put(self.opt_state, rep),
+            jax.device_put(self.opt_state_v, rep),
+            dev(obs, obs_sh), dev(actions, batch_sh),
+            dev(logp, batch_sh), dev(rewards, batch_sh),
+            dev(dones, batch_sh),
+            jax.device_put(last_obs, NamedSharding(self.mesh, P("dp"))),
+            jnp.int32(self._step_count))
+        params, opt_m, opt_v, loss = out
+        self.params = jax.tree.map(np.asarray, params)
+        self.opt_state = jax.tree.map(np.asarray, opt_m)
+        self.opt_state_v = jax.tree.map(np.asarray, opt_v)
+        self._step_count += cfg.epochs
+        self.iteration += 1
+
+        mean_ret = (float(np.mean(self._recent_returns))
+                    if self._recent_returns else 0.0)
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "num_env_steps_sampled": (self.iteration * cfg.rollout_length
+                                      * cfg.num_env_runners
+                                      * cfg.num_envs_per_runner),
+            "loss": float(loss),
+            "time_this_iter_s": time.perf_counter() - t_start,
+        }
+
+    # -- checkpointing -------------------------------------------------
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({"params": self.params,
+                         "opt_m": self.opt_state,
+                         "opt_v": self.opt_state_v,
+                         "iteration": self.iteration,
+                         "step_count": self._step_count}, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.params = state["params"]
+        self.opt_state = state["opt_m"]
+        self.opt_state_v = state["opt_v"]
+        self.iteration = state["iteration"]
+        self._step_count = state["step_count"]
+
+    def stop(self) -> None:
+        self.runners.shutdown()
+        if self._pg is not None:
+            from ray_tpu.util.placement_group import remove_placement_group
+            try:
+                remove_placement_group(self._pg)
+            except Exception:
+                pass

@@ -1,0 +1,93 @@
+"""RL layer tests: vectorized env, rollout actors, PPO learning.
+
+Reference analog: RLlib CI "learning tests" — short training runs must
+reach a reward threshold (``rllib/utils/test_utils.py``) [UNVERIFIED —
+mount empty, SURVEY.md §0].
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import PPO, PPOConfig, CartPoleVec, EnvRunnerGroup
+from ray_tpu.rl.ppo import init_policy_params
+
+
+def test_vector_env_semantics():
+    env = CartPoleVec(8, seed=0)
+    obs = env.observe()
+    assert obs.shape == (8, 4)
+    total_done = 0
+    for _ in range(300):
+        obs, rew, done = env.step(np.random.randint(0, 2, 8))
+        assert rew.shape == (8,)
+        total_done += int(done.sum())
+    # random policy terminates episodes well before 300 steps
+    assert total_done > 8
+    assert len(env.completed_returns) == total_done
+
+
+def test_env_runner_group_collects(ray_start_regular):
+    import jax
+    params = init_policy_params(jax.random.PRNGKey(0), 4, 2)
+    group = EnvRunnerGroup("CartPole", num_runners=2,
+                           num_envs_per_runner=4, seed=0)
+    rollouts = group.collect(params, rollout_len=16)
+    assert len(rollouts) == 2
+    for r in rollouts:
+        assert r["obs"].shape == (16, 4, 4)
+        assert r["actions"].shape == (16, 4)
+        assert r["logp"].shape == (16, 4)
+        assert r["last_obs"].shape == (4, 4)
+        assert set(np.unique(r["actions"])) <= {0, 1}
+    group.shutdown()
+
+
+def test_ppo_learns_cartpole(ray_start_regular):
+    """The RLlib-style learning test: PPO must lift CartPole returns
+    well above the random-policy baseline within a bounded budget."""
+    algo = (PPOConfig()
+            .environment("CartPole")
+            .env_runners(num_env_runners=2, num_envs_per_runner=16,
+                         rollout_length=128)
+            .training(lr=3e-3, epochs=10, entropy_coeff=0.01, seed=1)
+            .build())
+    try:
+        first = algo.train()
+        assert first["training_iteration"] == 1
+        best = 0.0
+        for _ in range(30):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 120.0:
+                break
+        assert best >= 120.0, f"PPO failed to learn: best={best}"
+        # checkpoint round-trip preserves the learned policy
+        import tempfile, os
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "ckpt.pkl")
+            algo.save(path)
+            it = algo.iteration
+            algo.restore(path)
+            assert algo.iteration == it
+    finally:
+        algo.stop()
+
+
+def test_ppo_resource_gang(ray_start_regular):
+    """The PG reserves the heterogeneous learner+runner bundles."""
+    algo = (PPOConfig()
+            .environment("CartPole")
+            .env_runners(num_env_runners=2, num_envs_per_runner=4,
+                         rollout_length=8)
+            .build())
+    try:
+        assert algo._pg is not None
+        from ray_tpu.util.placement_group import placement_group_table
+        entries = [e for e in placement_group_table()
+                   if e.get("state") == "CREATED"]
+        assert entries, "ppo placement group not created"
+        result = algo.train()
+        assert result["num_env_steps_sampled"] == 8 * 2 * 4
+    finally:
+        algo.stop()
