@@ -49,9 +49,10 @@ def mha_reference(q, k, v, *, causal: bool = True,
 # Pallas forward kernel
 # --------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
-                      sm_scale: float, block_k: int):
-    # q_ref: [block_q, H]; k_ref/v_ref: [S_k, H]; o_ref: [block_q, H]
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      causal: bool, sm_scale: float, block_k: int):
+    # q_ref: [block_q, H]; k_ref/v_ref: [S_k, H]; o_ref: [block_q, H];
+    # lse_ref: [block_q] log-sum-exp residual for the flash backward.
     block_q, head_dim = q_ref.shape
     seq_k = k_ref.shape[0]
     qi = pl.program_id(1)
@@ -102,7 +103,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
     else:
         n_iter = n_kv
     o, m, l = jax.lax.fori_loop(0, n_iter, body, (o, m, l))
-    o_ref[:] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[:] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = m + jnp.log(l_safe)
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
@@ -117,7 +120,7 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     grid = (b * n, pl.cdiv(s_q, block_q))
     kernel = functools.partial(_flash_fwd_kernel, causal=causal,
                                sm_scale=sm_scale, block_k=block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -125,23 +128,208 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, s_k, h), lambda bn, i: (bn, 0, 0)),
             pl.BlockSpec((1, s_k, h), lambda bn, i: (bn, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, h), lambda bn, i: (bn, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * n, s_q, h), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, h), lambda bn, i: (bn, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bn, i: (bn, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n, s_q, h), q.dtype),
+            jax.ShapeDtypeStruct((b * n, s_q), jnp.float32),
+        ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, n, s_q, h).transpose(0, 2, 1, 3)
+    return out.reshape(b, n, s_q, h).transpose(0, 2, 1, 3), lse
 
 
 # Pallas BlockSpec blocks carry the leading singleton; squeeze inside.
 def _squeeze_kernel(kernel):
     @functools.wraps(kernel)
-    def wrapped(q_ref, k_ref, v_ref, o_ref, **kw):
-        return kernel(q_ref.at[0], k_ref.at[0], v_ref.at[0], o_ref.at[0],
-                      **kw)
+    def wrapped(*refs, **kw):
+        return kernel(*[r.at[0] for r in refs], **kw)
     return wrapped
 
 
 _flash_fwd_kernel = _squeeze_kernel(_flash_fwd_kernel)
+
+
+# --------------------------------------------------------------------------
+# Pallas backward kernels (FlashAttention-2 style)
+# --------------------------------------------------------------------------
+#
+# Residuals are O and the per-row log-sum-exp L; probabilities are
+# recomputed blockwise from them, so the backward — like the forward —
+# never materializes an S×S matrix in HBM:
+#   D_i  = rowsum(dO_i ∘ O_i)
+#   P_ij = exp(q_i k_j^T · scale − L_i)
+#   dV_j = Σ_i P_ij^T dO_i
+#   dS_ij = P_ij ∘ (dO_i V_j^T − D_i) · scale
+#   dQ_i = Σ_j dS_ij K_j ;  dK_j = Σ_i dS_ij^T Q_i
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, causal: bool, sm_scale: float,
+                         block_k: int):
+    # q/do/dq: [block_q, H]; k/v: [S_k, H]; lse/delta: [block_q]
+    block_q, head_dim = q_ref.shape
+    seq_k = k_ref.shape[0]
+    qi = pl.program_id(1)
+
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]
+    delta = delta_ref[:]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    n_kv = pl.cdiv(seq_k, block_k)
+
+    def body(j, dq):
+        start = jnp.minimum(j * block_k, seq_k - block_k)
+        k_blk = k_ref[pl.ds(start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        k_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos >= j * block_k        # clamped-tail de-dup
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        n_iter = jnp.minimum(n_kv, pl.cdiv((qi + 1) * block_q, block_k))
+    else:
+        n_iter = n_kv
+    dq = jax.lax.fori_loop(
+        0, n_iter, body, jnp.zeros((block_q, head_dim), jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, causal: bool, sm_scale: float,
+                          block_q: int):
+    # k/v/dk/dv: [block_k, H]; q/do: [S_q, H]; lse/delta: [S_q]
+    block_k, head_dim = k_ref.shape
+    seq_q = q_ref.shape[0]
+    ki = pl.program_id(1)
+
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    n_q = pl.cdiv(seq_q, block_q)
+
+    def body(i, carry):
+        dk, dv = carry
+        start = jnp.minimum(i * block_q, seq_q - block_q)
+        q_blk = q_ref[pl.ds(start, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[pl.ds(start, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[pl.ds(start, block_q)]
+        delta_blk = delta_ref[pl.ds(start, block_q)]
+        s = jax.lax.dot_general(
+            q_blk, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        q_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = q_pos >= i * block_q        # clamped-tail de-dup
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_blk, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk[:, None]) * sm_scale
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    if causal:
+        # first query block whose rows can attend to this kv block
+        i0 = (ki * block_k) // block_q
+    else:
+        i0 = 0
+    dk, dv = jax.lax.fori_loop(
+        i0, n_q, body,
+        (jnp.zeros((block_k, head_dim), jnp.float32),
+         jnp.zeros((block_k, head_dim), jnp.float32)))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+_flash_bwd_dq_kernel = _squeeze_kernel(_flash_bwd_dq_kernel)
+_flash_bwd_dkv_kernel = _squeeze_kernel(_flash_bwd_dkv_kernel)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
+               interpret):
+    b, s_q, n, h = q.shape
+    s_k = k.shape[1]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_k)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * n, s_q, h)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * n, s_k, h)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * n, s_k, h)
+    dot = g.transpose(0, 2, 1, 3).reshape(b * n, s_q, h)
+    # delta = rowsum(dO ∘ O): cheap elementwise outside the kernels
+    delta = jnp.sum(dot.astype(jnp.float32)
+                    * out.transpose(0, 2, 1, 3).reshape(b * n, s_q, h)
+                    .astype(jnp.float32), axis=-1)          # [BN, S_q]
+
+    dq_kernel = functools.partial(_flash_bwd_dq_kernel, causal=causal,
+                                  sm_scale=sm_scale, block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * n, pl.cdiv(s_q, block_q)),
+        in_specs=[
+            pl.BlockSpec((1, block_q, h), lambda bn, i: (bn, i, 0)),
+            pl.BlockSpec((1, s_k, h), lambda bn, i: (bn, 0, 0)),
+            pl.BlockSpec((1, s_k, h), lambda bn, i: (bn, 0, 0)),
+            pl.BlockSpec((1, block_q, h), lambda bn, i: (bn, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bn, i: (bn, i)),
+            pl.BlockSpec((1, block_q), lambda bn, i: (bn, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, h), lambda bn, i: (bn, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * n, s_q, h), q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, causal=causal,
+                                   sm_scale=sm_scale, block_q=block_q)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * n, pl.cdiv(s_k, block_k)),
+        in_specs=[
+            pl.BlockSpec((1, s_q, h), lambda bn, j: (bn, 0, 0)),
+            pl.BlockSpec((1, block_k, h), lambda bn, j: (bn, j, 0)),
+            pl.BlockSpec((1, block_k, h), lambda bn, j: (bn, j, 0)),
+            pl.BlockSpec((1, s_q, h), lambda bn, j: (bn, 0, 0)),
+            pl.BlockSpec((1, s_q), lambda bn, j: (bn, 0)),
+            pl.BlockSpec((1, s_q), lambda bn, j: (bn, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, h), lambda bn, j: (bn, j, 0)),
+            pl.BlockSpec((1, block_k, h), lambda bn, j: (bn, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * n, s_k, h), k.dtype),
+            jax.ShapeDtypeStruct((b * n, s_k, h), v.dtype),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    unfold = lambda x, s: x.reshape(b, n, s, h).transpose(0, 2, 1, 3)
+    return unfold(dq, s_q), unfold(dk, s_k), unfold(dv, s_k)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -154,25 +342,30 @@ def flash_attention(q, k, v, causal: bool = True,
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
-    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
-                      interpret)
+    out, _lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                           interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, sm_scale, block_q, block_k,
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
                           interpret)
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret,
                    residuals, g):
-    q, k, v = residuals
-    # Recompute-based exact gradient (flash-style backward is a later
-    # optimization; this keeps HBM use flat at the cost of FLOPs).
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: mha_reference(q_, k_, v_, causal=causal,
-                                         sm_scale=sm_scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    return _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q,
+                      block_k, interpret)
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
