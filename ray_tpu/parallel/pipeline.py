@@ -29,7 +29,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:
-    from jax import shard_map
+    from jax import shard_map as _jax_shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return _jax_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_rep)
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
