@@ -113,7 +113,8 @@ _D("actor_max_restarts", int, 0, "Default actor restart count.")
 _D("max_direct_call_object_size", int, 100 * 1024,
    "Results at or below this size are inlined in the reply instead of "
    "going through the shared-memory store.")
-_D("worker_lease_timeout_ms", int, 30000, "Lease RPC timeout.")
+_D("worker_lease_timeout_ms", int, 30000,
+   "Timeout for a lease/submit RPC to a remote raylet.")
 _D("task_events_max_buffer", int, 100000,
    "Ring-buffer capacity of the per-worker task event stream.")
 
@@ -139,7 +140,6 @@ _D("gcs_mode", str, "inproc",
 _D("health_check_period_ms", int, 1000, "GCS -> node health ping period.")
 _D("health_check_failure_threshold", int, 5,
    "Missed pings before a node is declared dead.")
-_D("gcs_pubsub_poll_timeout_ms", int, 10000, "Long-poll timeout.")
 
 # --- logging / events ---
 _D("event_log_enabled", bool, True, "Structured event log to session dir.")

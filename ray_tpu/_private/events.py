@@ -42,6 +42,11 @@ def record(task_id_hex: str, name: str, state: str,
     })
 
 
+def raw_events() -> List[dict]:
+    """The raw (task, state, ts) transition stream, oldest first."""
+    return list(_buffer())
+
+
 def get_task_events() -> List[dict]:
     """Chrome-trace ("catapult") event dicts: pair RUNNING->FINISHED."""
     events = list(_buffer())

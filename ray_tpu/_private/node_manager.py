@@ -394,8 +394,10 @@ class NodeManagerGroup:
             self._running[spec.task_id] = RunningTask(
                 spec, handle.node_id, _RemoteLease(handle),
                 dict(spec.resources), pg=self._spec_pg(spec))
+        lease_timeout = get_config().worker_lease_timeout_ms / 1000.0
         try:
-            status = handle.client.call("submit", payload, timeout=30)
+            status = handle.client.call("submit", payload,
+                                        timeout=lease_timeout)
         except Exception:
             with self._lock:
                 self._running.pop(spec.task_id, None)
@@ -672,7 +674,9 @@ class NodeManagerGroup:
             payload = dict(payload, resources={},
                            function_id=payload["function_id"])
             try:
-                worker.handle.client.call("submit", payload, timeout=30)
+                worker.handle.client.call(
+                    "submit", payload,
+                    timeout=get_config().worker_lease_timeout_ms / 1000.0)
             except Exception:
                 with self._lock:
                     self._running.pop(spec.task_id, None)

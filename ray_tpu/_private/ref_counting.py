@@ -113,3 +113,15 @@ class ReferenceCounter:
                 "num_owned": len(self._owned),
                 "num_local_tracked": len(self._local),
             }
+
+    def snapshot(self) -> dict:
+        """Per-owned-object count breakdown (state API)."""
+        with self._lock:
+            return {
+                oid: {
+                    "local_refs": self._local.get(oid, 0),
+                    "task_args": self._task_args.get(oid, 0),
+                    "contained_in": self._contained_in.get(oid, 0),
+                }
+                for oid in self._owned
+            }

@@ -1,0 +1,65 @@
+"""Built-in runtime metrics.
+
+Reference: ``src/ray/stats/metric_defs.cc`` (``ray_tasks{State=...}``,
+``ray_object_store_memory``, scheduler gauges) exported through the
+per-node metrics agent [UNVERIFIED — mount empty, SURVEY.md §0].
+System series register in the same registry as user metrics
+(``ray_tpu.util.metrics``) and refresh at scrape time from the live
+runtime, so one /metrics endpoint covers both.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.util import metrics as m
+
+_installed = False
+
+
+def install_runtime_metrics() -> None:
+    """Idempotent; safe across init/shutdown cycles (the collector
+    no-ops when no runtime is live)."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+
+    tasks = m.Gauge("ray_tpu_tasks", "Task counts by state",
+                    tag_keys=("state",))
+    objects = m.Gauge("ray_tpu_object_store_bytes",
+                      "Shared-memory store usage", tag_keys=("kind",))
+    hbm = m.Gauge("ray_tpu_device_object_bytes",
+                  "HBM-resident object bytes")
+    sched = m.Gauge("ray_tpu_scheduler", "Scheduler queue sizes",
+                    tag_keys=("queue",))
+    nodes = m.Gauge("ray_tpu_nodes", "Cluster nodes by liveness",
+                    tag_keys=("state",))
+    actors = m.Gauge("ray_tpu_actors", "Actors by state",
+                     tag_keys=("state",))
+
+    def collect():
+        from ray_tpu._private.worker import try_global_worker
+        w = try_global_worker()
+        if w is None:
+            return
+        tm = w.task_manager.stats()
+        for state in ("pending", "finished", "failed", "retries"):
+            tasks.set(tm.get(state, 0), tags={"state": state})
+        store = w.shm_store.stats()
+        objects.set(store["used_bytes"], tags={"kind": "used"})
+        objects.set(store["capacity_bytes"], tags={"kind": "capacity"})
+        hbm.set(w.device_store.stats()["hbm_bytes"])
+        ng = w.node_group.stats()
+        for queue in ("to_schedule", "waiting_deps", "running",
+                      "infeasible"):
+            sched.set(ng.get(queue, 0), tags={"queue": queue})
+        infos = w.gcs.get_all_node_info()
+        nodes.set(sum(1 for i in infos if i.alive), tags={"state": "alive"})
+        nodes.set(sum(1 for i in infos if not i.alive),
+                  tags={"state": "dead"})
+        by_state: dict = {}
+        for info in w.gcs.list_actors():
+            by_state[info.state] = by_state.get(info.state, 0) + 1
+        for state, count in by_state.items():
+            actors.set(count, tags={"state": state})
+
+    m.register_collector(collect)

@@ -205,6 +205,10 @@ class TaskManager:
                 if rec and rec.status in ("finished", "failed"):
                     self._tasks.pop(tid, None)
 
+    def list_records(self) -> List[TaskRecord]:
+        with self._lock:
+            return list(self._tasks.values())
+
     def num_pending(self) -> int:
         with self._lock:
             return sum(1 for r in self._tasks.values()

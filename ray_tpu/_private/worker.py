@@ -167,6 +167,9 @@ class Worker:
         self._actor_restarts: Dict[ActorID, int] = {}
         self._actor_flush_locks: Dict[ActorID, threading.RLock] = {}
 
+        from ray_tpu._private.stats import install_runtime_metrics
+        install_runtime_metrics()
+
         prestart = cfg.worker_pool_prestart
         if prestart:
             raylet = self.node_group._raylets[self.node_group.head_node_id]
@@ -870,6 +873,12 @@ _global_lock = threading.Lock()
 
 def init(**kwargs) -> Worker:
     global _global_worker
+    if os.environ.get("RAY_TPU_WORKER_MODE") == "1":
+        raise RuntimeError(
+            "ray_tpu API calls inside task/actor workers are not "
+            "supported: workers are pure executors in this runtime. "
+            "Submit follow-up work from the driver (e.g. chain tasks "
+            "on returned ObjectRefs).")
     with _global_lock:
         if _global_worker is not None:
             return _global_worker

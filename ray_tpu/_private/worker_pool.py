@@ -63,6 +63,7 @@ class ProcessWorker(BaseWorker):
         env = dict(os.environ)
         # Children never own the TPU; any jax they import runs on CPU.
         env["JAX_PLATFORMS"] = "cpu"
+        env["RAY_TPU_WORKER_MODE"] = "1"
         env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
         env["PYTHONPATH"] = os.pathsep.join(
             [os.path.dirname(os.path.dirname(os.path.dirname(
@@ -228,6 +229,20 @@ class WorkerPool:
                         now - w.start_time > cfg.worker_start_timeout_s:
                     w.alive = False
                     self._all.pop(w.worker_id, None)
+        # Reap process workers idle beyond worker_pool_max_idle_s,
+        # always keeping one warm (reference: idle worker killing).
+        max_idle = cfg.worker_pool_max_idle_s
+        while len(self._idle_process) > 1:
+            oldest = min(self._idle_process, key=lambda w: w.last_idle)
+            if now - oldest.last_idle <= max_idle:
+                break
+            self._idle_process.remove(oldest)
+            self._all.pop(oldest.worker_id, None)
+            try:
+                oldest.send(("shutdown",))
+            except Exception:
+                pass
+            oldest.kill()
 
     def push_worker(self, worker: BaseWorker) -> None:
         with self._lock:

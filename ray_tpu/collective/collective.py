@@ -92,9 +92,26 @@ def init_collective_group(world_size: int, rank: int,
                           group_name: str = "default",
                           timeout_s: float = 60.0) -> None:
     """Join a collective group. Every member must call this with the
-    same ``group_name`` and ``world_size`` and a distinct ``rank``."""
-    if backend not in ("shm", "gloo", "nccl"):
+    same ``group_name`` and ``world_size`` and a distinct ``rank``.
+
+    Backends: ``shm`` (single-host actor plane) and ``xla`` (ICI
+    collectives compiled into programs — see ``collective.xla``; named
+    here for API parity, it needs no group rendezvous). The reference's
+    ``nccl``/``gloo`` names are rejected rather than silently aliased:
+    this framework's device collectives are XLA ops, not NCCL rings.
+    """
+    if backend in ("nccl", "gloo"):
+        raise ValueError(
+            f"backend {backend!r} does not exist on TPU builds: device "
+            "collectives compile into XLA programs (use the mesh + "
+            "jax.lax collectives, ray_tpu.collective.xla); the host "
+            "plane backend is 'shm'")
+    if backend not in ("shm", "xla"):
         raise ValueError(f"unknown backend {backend!r}")
+    if backend == "xla":
+        raise ValueError(
+            "the 'xla' backend needs no collective group: collectives "
+            "are ops inside jitted programs over a Mesh")
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world {world_size}")
     root = os.path.join(_BASE, group_name)

@@ -1,0 +1,165 @@
+"""util layer tests: ActorPool, Queue, metrics, state API, collective
+backend validation.
+
+Reference analogs: ``python/ray/tests/test_actor_pool.py``,
+``test_queue.py``, ``test_metrics_agent.py``, state API tests
+[UNVERIFIED — mount empty, SURVEY.md §0].
+"""
+
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+from ray_tpu.util import metrics as m
+from ray_tpu.util import state
+
+
+@ray_tpu.remote
+class _Sq:
+    def compute(self, x):
+        return x * x
+
+
+def test_actor_pool_ordered_and_unordered(ray_start_regular):
+    actors = [_Sq.remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    out = list(pool.map(lambda a, v: a.compute.remote(v), range(8)))
+    assert out == [i * i for i in range(8)]
+    out2 = sorted(pool.map_unordered(
+        lambda a, v: a.compute.remote(v), range(8)))
+    assert out2 == sorted(i * i for i in range(8))
+
+
+def test_actor_pool_submit_get_next(ray_start_regular):
+    pool = ActorPool([_Sq.remote()])
+    pool.submit(lambda a, v: a.compute.remote(v), 3)
+    assert pool.has_next()
+    assert not pool.has_free()
+    assert pool.get_next(timeout=60) == 9
+    assert pool.has_free()
+
+
+def test_queue_roundtrip_and_bounds(ray_start_regular):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.put_batch([10, 11])
+    assert q.get() == 10
+    q.shutdown()
+
+
+def test_queue_blocking_get(ray_start_regular):
+    """get() blocks until a producer (another driver thread) puts."""
+    import threading
+
+    q = Queue()
+
+    def producer():
+        time.sleep(0.3)
+        q.put("late")
+
+    threading.Thread(target=producer, daemon=True).start()
+    assert q.get(timeout=30) == "late"
+    q.shutdown()
+
+
+def test_nested_api_calls_raise_clearly(ray_start_regular):
+    """Workers are pure executors: in-task ray_tpu usage surfaces a
+    clear error, not a nested runtime."""
+
+    @ray_tpu.remote
+    def nested():
+        import ray_tpu as rt
+        rt.init()
+
+    with pytest.raises(RuntimeError, match="pure executors"):
+        ray_tpu.get(nested.remote())
+
+
+def test_metrics_counter_gauge_histogram():
+    c = m.Counter("t_requests", "reqs", tag_keys=("route",))
+    c.inc(2, tags={"route": "a"})
+    c.inc(1, tags={"route": "b"})
+    g = m.Gauge("t_depth", "queue depth")
+    g.set(7)
+    h = m.Histogram("t_latency", "lat", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = m.prometheus_text()
+    assert 't_requests{route="a"} 2.0' in text
+    assert "t_depth 7.0" in text
+    assert 't_latency_bucket{le="0.1"} 1' in text
+    assert 't_latency_bucket{le="+Inf"} 3' in text
+    assert "t_latency_count 3" in text
+    with pytest.raises(ValueError):
+        c.inc(1, tags={"bogus": "x"})
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_metrics_http_endpoint_and_system_series(ray_start_regular):
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get(noop.remote())
+    host, port = m.start_metrics_server()
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=30) as resp:
+            text = resp.read().decode()
+        assert "ray_tpu_tasks" in text
+        assert 'ray_tpu_object_store_bytes{kind="capacity"}' in text
+        assert "ray_tpu_nodes" in text
+    finally:
+        m.stop_metrics_server()
+
+
+def test_state_api_lists(ray_start_regular):
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class A:
+        def hi(self):
+            return "hi"
+
+    ray_tpu.get([work.remote(i) for i in range(3)])
+    a = A.remote()
+    ray_tpu.get(a.hi.remote())
+
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1 and any(n["is_head"] for n in nodes)
+    actors = state.list_actors()
+    assert any(x["class_name"] == "A" and x["state"] == "ALIVE"
+               for x in actors)
+    tasks = state.list_tasks()
+    assert sum(1 for t in tasks if t["status"] == "finished") >= 3
+    objs = state.list_objects()
+    assert isinstance(objs, list)
+    s = state.summary()
+    assert s["tasks"]["finished"] >= 3
+    assert s["actors"]["ALIVE"] >= 1
+    workers = state.list_workers()
+    assert any(w["kind"] == "logical" for w in workers)
+
+
+def test_collective_rejects_foreign_backends(ray_start_regular):
+    from ray_tpu.collective import init_collective_group
+    with pytest.raises(ValueError, match="XLA"):
+        init_collective_group(2, 0, backend="nccl")
+    with pytest.raises(ValueError, match="unknown backend"):
+        init_collective_group(2, 0, backend="mpi")
