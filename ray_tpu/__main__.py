@@ -1,0 +1,5 @@
+from ray_tpu.scripts.cli import main
+
+import sys
+
+sys.exit(main())

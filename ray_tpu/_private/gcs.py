@@ -60,6 +60,8 @@ class NodeInfo:
     labels: Dict[str, str] = field(default_factory=dict)
     alive: bool = True
     start_time: float = field(default_factory=time.time)
+    # raylet lease/object-manager endpoint (None for in-driver nodes)
+    rpc_addr: Optional[Tuple[str, int]] = None
 
 
 class GcsLite:

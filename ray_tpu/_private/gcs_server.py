@@ -86,6 +86,8 @@ class GcsServer:
 
     def _register_node(self, ctx: ConnectionContext, info: NodeInfo,
                        rpc_addr: Optional[Tuple[str, int]]) -> None:
+        if rpc_addr is not None:
+            info.rpc_addr = tuple(rpc_addr)
         self.state.register_node(info)
         if rpc_addr is not None:
             with self._health_lock:
@@ -214,10 +216,12 @@ def spawn_gcs_process(session: str, config_json: str = ""
             os.path.abspath(__file__))))]
         + env.get("PYTHONPATH", "").split(os.pathsep))
     env["JAX_PLATFORMS"] = "cpu"   # the GCS never touches the TPU
+    log = open(os.path.join(d, "gcs.log"), "ab")
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu._private.gcs_server",
          "--port-file", port_file, "--config", config_json],
-        env=env, start_new_session=True)
+        env=env, start_new_session=True, stdout=log, stderr=log)
+    log.close()
     deadline = time.monotonic() + 20.0
     while time.monotonic() < deadline:
         if os.path.exists(port_file):

@@ -474,6 +474,7 @@ class NodeManagerGroup:
             "num_returns": spec.num_returns,
             "return_ids": [o.binary() for o in spec.return_ids],
             "name": spec.repr_name(),
+            "runtime_env": spec.runtime_env,
             "resources": dict(spec.resources),
         }
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
@@ -894,6 +895,23 @@ class NodeManagerGroup:
             with self._lock:
                 self._to_schedule.extend(retry)
 
+    def pending_resource_demand(self) -> List[Dict[str, float]]:
+        """Resource shapes of tasks the cluster cannot currently place
+        (the autoscaler's demand signal; reference: GCS autoscaler
+        resource-demand state)."""
+        demands: List[Dict[str, float]] = []
+        with self._lock:
+            demands.extend(dict(s.resources)
+                           for s in self._infeasible.values())
+            demands.extend(dict(s.resources) for s in self._to_schedule)
+        if self.pg_manager is not None:
+            with self.pg_manager._lock:
+                for pg_id in list(self.pg_manager._pending):
+                    info = self.pg_manager.get(pg_id)
+                    if info is not None:
+                        demands.extend(dict(b) for b in info.bundles)
+        return demands
+
     def recheck_infeasible(self) -> None:
         with self._lock:
             specs = list(self._infeasible.values())
@@ -1008,6 +1026,7 @@ class NodeManagerGroup:
             "num_returns": spec.num_returns,
             "return_ids": [o.binary() for o in spec.return_ids],
             "name": spec.repr_name(),
+            "runtime_env": spec.runtime_env,
         }
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             payload["actor_id"] = spec.actor_creation_id.binary()

@@ -496,7 +496,10 @@ def spawn_raylet_process(session: str, node_id: NodeID,
            "--config", get_config().serialize()]
     if gcs_addr is not None:
         cmd += ["--gcs", f"{gcs_addr[0]}:{gcs_addr[1]}"]
-    proc = subprocess.Popen(cmd, env=env, start_new_session=True)
+    log = open(os.path.join(d, f"raylet_{node_id.hex()[:12]}.log"), "ab")
+    proc = subprocess.Popen(cmd, env=env, start_new_session=True,
+                            stdout=log, stderr=log)
+    log.close()
     deadline = time.monotonic() + 30.0
     while time.monotonic() < deadline:
         if os.path.exists(port_file):

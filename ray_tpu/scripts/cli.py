@@ -1,0 +1,154 @@
+"""CLI: cluster bootstrap + introspection.
+
+Reference: ``python/ray/scripts/scripts.py`` (``ray start/stop/status/
+timeline``) [UNVERIFIED — mount empty, SURVEY.md §0]. argparse-based:
+
+  python -m ray_tpu start --head [--session NAME]
+  python -m ray_tpu start --address HOST:PORT --num-cpus 8
+  python -m ray_tpu status --address HOST:PORT
+  python -m ray_tpu stop [--session NAME]
+  python -m ray_tpu workflows [--storage DIR]
+
+``start --head`` spawns a standalone GCS process and prints its
+address; ``start --address`` spawns a raylet process that registers
+there; a driver joins with ``ray_tpu.init(address="HOST:PORT")``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+
+def _cmd_start(args) -> int:
+    from ray_tpu._private.config import get_config
+
+    session = args.session
+    if args.head:
+        from ray_tpu._private.gcs_server import spawn_gcs_process
+        proc, addr = spawn_gcs_process(session, get_config().serialize())
+        print(f"GCS started (pid {proc.pid}) at {addr[0]}:{addr[1]}")
+        print(f"Join a driver with: ray_tpu.init("
+              f"address=\"{addr[0]}:{addr[1]}\")")
+        print(f"Add a node with: python -m ray_tpu start "
+              f"--address {addr[0]}:{addr[1]} --num-cpus 4")
+        return 0
+    if not args.address:
+        print("start needs --head or --address HOST:PORT",
+              file=sys.stderr)
+        return 2
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.raylet_server import spawn_raylet_process
+    host, port = args.address.rsplit(":", 1)
+    resources = {"CPU": float(args.num_cpus)}
+    if args.num_tpus:
+        resources["TPU"] = float(args.num_tpus)
+    if args.resources:
+        resources.update({k: float(v)
+                          for k, v in json.loads(args.resources).items()})
+    node_id = NodeID.from_random()
+    node_session = f"{session}_{node_id.hex()[:8]}"
+    proc, addr = spawn_raylet_process(
+        node_session, node_id, resources, gcs_addr=(host, int(port)),
+        max_process_workers=args.max_workers)
+    print(f"raylet started (pid {proc.pid}) node {node_id.hex()[:12]} "
+          f"at {addr[0]}:{addr[1]} resources={resources}")
+    return 0
+
+
+def _cmd_status(args) -> int:
+    from ray_tpu._private.gcs_client import GcsClient
+    host, port = args.address.rsplit(":", 1)
+    client = GcsClient((host, int(port)))
+    try:
+        nodes = client.get_all_node_info()
+        print(f"{'NODE':14} {'ALIVE':6} {'ADDRESS':22} RESOURCES")
+        for info in nodes:
+            addr = (f"{info.rpc_addr[0]}:{info.rpc_addr[1]}"
+                    if info.rpc_addr else "-")
+            print(f"{info.node_id.hex()[:12]:14} "
+                  f"{str(info.alive):6} {addr:22} "
+                  f"{info.resources_total}")
+        actors = client.list_actors()
+        if actors:
+            print(f"\n{'ACTOR':14} {'CLASS':20} STATE")
+            for a in actors:
+                print(f"{a.actor_id.hex()[:12]:14} "
+                      f"{a.class_name:20} {a.state}")
+    finally:
+        client.close()
+    return 0
+
+
+def _cmd_stop(args) -> int:
+    """Terminate this session's GCS/raylet processes (by port files +
+    process table)."""
+    import glob
+    import subprocess
+    killed = 0
+    pattern = f"rtpu_{args.session}" if args.session else "rtpu_"
+    out = subprocess.run(
+        ["pgrep", "-af", "ray_tpu._private.(gcs_server|raylet_server)"],
+        capture_output=True, text=True).stdout
+    for line in out.splitlines():
+        pid_s, _, cmd = line.partition(" ")
+        if pattern in cmd or not args.session:
+            try:
+                os.kill(int(pid_s), signal.SIGTERM)
+                killed += 1
+            except (ProcessLookupError, ValueError):
+                pass
+    for d in glob.glob(f"/tmp/{pattern}*"):
+        pass  # session dirs cleaned by their owners; addresses go stale
+    print(f"terminated {killed} process(es)")
+    return 0
+
+
+def _cmd_workflows(args) -> int:
+    from ray_tpu import workflow
+    rows = workflow.list_all(args.storage)
+    if not rows:
+        print("no workflows")
+        return 0
+    for wid, status in rows:
+        print(f"{wid:32} {status}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="start a GCS head or a raylet")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default="",
+                    help="GCS address to join (HOST:PORT)")
+    sp.add_argument("--session", default="cli")
+    sp.add_argument("--num-cpus", type=float, default=4)
+    sp.add_argument("--num-tpus", type=float, default=0)
+    sp.add_argument("--resources", default="",
+                    help="extra resources as JSON")
+    sp.add_argument("--max-workers", type=int, default=2)
+    sp.set_defaults(fn=_cmd_start)
+
+    sp = sub.add_parser("status", help="cluster state from the GCS")
+    sp.add_argument("--address", required=True)
+    sp.set_defaults(fn=_cmd_status)
+
+    sp = sub.add_parser("stop", help="terminate cluster processes")
+    sp.add_argument("--session", default="")
+    sp.set_defaults(fn=_cmd_stop)
+
+    sp = sub.add_parser("workflows", help="list workflows")
+    sp.add_argument("--storage", default=None)
+    sp.set_defaults(fn=_cmd_workflows)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

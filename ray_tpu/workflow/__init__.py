@@ -1,0 +1,124 @@
+"""ray_tpu.workflow — durable DAG execution with resume.
+
+Reference: ``python/ray/workflow/`` [UNVERIFIED — mount empty,
+SURVEY.md §0]: run a DAG of tasks with every step's result persisted;
+after a crash, ``resume`` re-executes only the steps without a
+persisted result. The DAG itself is persisted at submission, so resume
+needs nothing but the workflow id.
+
+Storage layout ({storage}/{workflow_id}/):
+  dag.pkl          the cloudpickled DAG
+  status           RUNNING | SUCCEEDED | FAILED
+  step_<k>.pkl     pickled result of step k (topological index)
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.dag import CompiledDAG, DAGNode, FunctionNode, InputNode
+
+__all__ = ["run", "resume", "list_all", "delete", "get_status",
+           "WorkflowError"]
+
+_DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu/workflows")
+
+
+class WorkflowError(RuntimeError):
+    pass
+
+
+def _dir(workflow_id: str, storage: Optional[str]) -> str:
+    return os.path.join(storage or _DEFAULT_STORAGE, workflow_id)
+
+
+def _write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def run(dag: DAGNode, *args, workflow_id: str,
+        storage: Optional[str] = None) -> Any:
+    """Execute a pure-task DAG durably; returns the final result.
+
+    Each step's result persists before the next step starts; a re-run
+    (or ``resume``) skips persisted steps."""
+    d = _dir(workflow_id, storage)
+    os.makedirs(d, exist_ok=True)
+    compiled = CompiledDAG(dag)
+    for node in compiled._order:
+        if not isinstance(node, (FunctionNode, InputNode)):
+            raise WorkflowError(
+                "workflows support task DAGs only (FunctionNode/"
+                f"InputNode); found {type(node).__name__}")
+    _write(os.path.join(d, "dag.pkl"),
+           cloudpickle.dumps((dag, args)))
+    return _execute(compiled, args, d)
+
+
+def resume(workflow_id: str, storage: Optional[str] = None) -> Any:
+    """Re-drive a workflow from its persisted DAG + step results."""
+    d = _dir(workflow_id, storage)
+    dag_path = os.path.join(d, "dag.pkl")
+    if not os.path.exists(dag_path):
+        raise WorkflowError(f"no workflow {workflow_id!r} at {d}")
+    with open(dag_path, "rb") as f:
+        dag, args = cloudpickle.loads(f.read())
+    return _execute(CompiledDAG(dag), args, d)
+
+
+def _execute(compiled: CompiledDAG, inputs: tuple, d: str) -> Any:
+    _write(os.path.join(d, "status"), b"RUNNING")
+    values = {}
+    try:
+        for k, node in enumerate(compiled._order):
+            if isinstance(node, InputNode):
+                values[id(node)] = inputs[node.index]
+                continue
+            step_path = os.path.join(d, f"step_{k}.pkl")
+            if os.path.exists(step_path):
+                with open(step_path, "rb") as f:
+                    values[id(node)] = pickle.load(f)
+                continue
+            args = tuple(values[id(a)] if isinstance(a, DAGNode) else a
+                         for a in node.args)
+            kwargs = {key: values[id(v)] if isinstance(v, DAGNode) else v
+                      for key, v in node.kwargs.items()}
+            # Durability boundary: block on the step and persist its
+            # result before any dependent starts (reference: every step
+            # output is checkpointed).
+            result = ray_tpu.get(node._submit(args, kwargs))
+            _write(step_path, pickle.dumps(result))
+            values[id(node)] = result
+    except BaseException:
+        _write(os.path.join(d, "status"), b"FAILED")
+        raise
+    _write(os.path.join(d, "status"), b"SUCCEEDED")
+    return values[id(compiled.output)]
+
+
+def get_status(workflow_id: str, storage: Optional[str] = None) -> str:
+    path = os.path.join(_dir(workflow_id, storage), "status")
+    if not os.path.exists(path):
+        return "NOT_FOUND"
+    return open(path, "rb").read().decode()
+
+
+def list_all(storage: Optional[str] = None) -> List[tuple]:
+    base = storage or _DEFAULT_STORAGE
+    if not os.path.isdir(base):
+        return []
+    return [(wid, get_status(wid, storage))
+            for wid in sorted(os.listdir(base))]
+
+
+def delete(workflow_id: str, storage: Optional[str] = None) -> None:
+    import shutil
+    shutil.rmtree(_dir(workflow_id, storage), ignore_errors=True)

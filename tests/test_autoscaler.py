@@ -1,0 +1,66 @@
+"""Autoscaler tests: demand-driven launch, idle termination.
+
+Reference analog: ``python/ray/autoscaler/v2/tests`` [UNVERIFIED —
+mount empty, SURVEY.md §0].
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    ClusterNodeProvider,
+    NodeType,
+)
+
+
+def test_autoscaler_launches_for_infeasible_demand(ray_start_cluster):
+    cluster = ray_start_cluster
+    provider = ClusterNodeProvider(cluster)
+    scaler = Autoscaler(
+        provider,
+        [NodeType("gpuish", {"CPU": 2, "SCALE": 2}, max_workers=2)],
+        idle_timeout_s=1.5, period_s=0.1).start()
+    try:
+        @ray_tpu.remote(num_cpus=1, resources={"SCALE": 1})
+        def need_scale(x):
+            return x * 2
+
+        # Infeasible now: no node has SCALE. The autoscaler must add one.
+        refs = [need_scale.remote(i) for i in range(4)]
+        assert ray_tpu.get(refs, timeout=90) == [0, 2, 4, 6]
+        assert scaler.num_launched >= 1
+        assert scaler.stats()["managed_nodes"] >= 1
+
+        # After the work drains the node goes idle and is reaped.
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if scaler.stats()["managed_nodes"] == 0:
+                break
+            time.sleep(0.2)
+        assert scaler.stats()["managed_nodes"] == 0
+        assert scaler.num_terminated >= 1
+    finally:
+        scaler.stop()
+
+
+def test_autoscaler_respects_max_workers(ray_start_cluster):
+    cluster = ray_start_cluster
+    provider = ClusterNodeProvider(cluster)
+    scaler = Autoscaler(
+        provider,
+        [NodeType("cap", {"CPU": 1, "CAPPED": 1}, max_workers=1)],
+        idle_timeout_s=60, period_s=0.05).start()
+    try:
+        @ray_tpu.remote(num_cpus=1, resources={"CAPPED": 1})
+        def slow(i):
+            time.sleep(0.5)
+            return i
+
+        refs = [slow.remote(i) for i in range(4)]
+        assert sorted(ray_tpu.get(refs, timeout=90)) == [0, 1, 2, 3]
+        assert scaler.stats()["managed_nodes"] == 1   # capped at 1
+    finally:
+        scaler.stop()
