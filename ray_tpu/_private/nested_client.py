@@ -1,0 +1,187 @@
+"""Nested-call client: the public API inside task/actor workers.
+
+Reference: every Ray worker embeds a full CoreWorker, so user code can
+call ``ray.remote/get/put/wait`` from anywhere [UNVERIFIED — mount
+empty, SURVEY.md §0]. This runtime keeps workers as executors and
+serves the core API from the OWNER instead: a worker-side client
+speaking to the driver's nested-API handlers over the wire
+(``Worker._register_nested_handlers``). Ownership of every object and
+task stays with the driver — lineage, reconstruction, and refcounting
+need no distributed protocol.
+
+Deadlock avoidance: a nested ``get`` reports the calling task's id;
+the owner releases that task's resource allocation and lends its node
+one extra worker slot while the parent blocks (the reference's
+CPU-release-while-blocked).
+
+Actors cannot yet be created or called from inside tasks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.task_spec import FunctionDescriptor, TaskOptions
+from ray_tpu.exceptions import GetTimeoutError, TaskError
+
+_SHIPPED_OPTION_FIELDS = (
+    "num_cpus", "num_tpus", "num_gpus", "memory", "resources",
+    "num_returns", "max_retries", "name")
+
+
+class _NoopRefCounter:
+    """Ref lifetime of nested borrows is pinned owner-side."""
+
+    def add_local_reference(self, oid) -> None:
+        pass
+
+    def remove_local_reference(self, oid) -> None:
+        pass
+
+
+class NestedClient:
+    """Duck-type of the Worker surface the public API uses."""
+
+    def __init__(self, owner_addr: Tuple[str, int], task_id: bytes):
+        from ray_tpu._private.rpc import RpcClient
+        self._client = RpcClient(tuple(owner_addr))
+        self._task_id = task_id
+        self.serde = serialization.get_context()
+        self.reference_counter = _NoopRefCounter()
+        self.session = f"nested-{owner_addr[1]}"
+        self._fn_lock = threading.Lock()
+        self._shipped_fids: set = set()
+        self._fn_blobs: Dict[bytes, bytes] = {}
+
+    # -- functions -----------------------------------------------------
+
+    def register_function(self, fn) -> FunctionDescriptor:
+        blob = cloudpickle.dumps(fn)
+        fid = hashlib.sha1(blob).digest()
+        with self._fn_lock:
+            self._fn_blobs.setdefault(fid, blob)
+        return FunctionDescriptor(
+            function_id=fid,
+            module=getattr(fn, "__module__", "") or "",
+            name=getattr(fn, "__qualname__", repr(fn)))
+
+    # -- task submission -----------------------------------------------
+
+    def submit_task(self, fn_descriptor: FunctionDescriptor, args: tuple,
+                    kwargs: dict, options: TaskOptions) -> List[ObjectRef]:
+        kwargs_keys = list(kwargs.keys())
+        arg_descs = []
+        for value in list(args) + [kwargs[k] for k in kwargs_keys]:
+            if isinstance(value, ObjectRef):
+                arg_descs.append(("r", value.binary()))
+            else:
+                arg_descs.append(
+                    ("v", self.serde.serialize(value).to_bytes()))
+        options_dict = {f: getattr(options, f)
+                        for f in _SHIPPED_OPTION_FIELDS}
+        fid = fn_descriptor.function_id
+        blob = None
+        with self._fn_lock:
+            if fid not in self._shipped_fids:
+                blob = self._fn_blobs.get(fid)
+                self._shipped_fids.add(fid)
+        refs_b = self._client.call(
+            "nested_submit", fid, blob, fn_descriptor.name, arg_descs,
+            kwargs_keys, options_dict)
+        return [ObjectRef(ObjectID(b)) for b in refs_b]
+
+    # -- object plane ----------------------------------------------------
+
+    def get(self, refs: Sequence[ObjectRef],
+            timeout: Optional[float] = None) -> List[Any]:
+        rpc_timeout = None if timeout is None else timeout + 30.0
+        status, items = self._client.call(
+            "nested_get", self._task_id,
+            [r.id().binary() for r in refs], timeout,
+            timeout=rpc_timeout)
+        if status == "timeout":
+            raise GetTimeoutError("nested get() timed out")
+        out = []
+        for kind, blob in items:
+            value, _ = self.serde.deserialize_from_blob(memoryview(blob))
+            if kind == "err":
+                raise value.as_instanceof_cause() \
+                    if isinstance(value, TaskError) else value
+            out.append(value)
+        return out
+
+    def put(self, value: Any) -> ObjectRef:
+        blob = self.serde.serialize(value).to_bytes()
+        oid_b = self._client.call("nested_put", blob)
+        return ObjectRef(ObjectID(oid_b))
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        rpc_timeout = None if timeout is None else timeout + 30.0
+        ready_b = self._client.call(
+            "nested_wait", [r.id().binary() for r in refs], num_returns,
+            timeout, timeout=rpc_timeout)
+        ready_set = {ObjectID(b) for b in ready_b}
+        ready, not_ready = [], []
+        for r in refs:
+            (ready if r.id() in ready_set and len(ready) < num_returns
+             else not_ready).append(r)
+        return ready, not_ready
+
+    # -- unsupported surface ---------------------------------------------
+
+    def _unsupported(self, what: str):
+        raise NotImplementedError(
+            f"{what} from inside a task/actor is not supported yet; "
+            "create actors from the driver and pass handles if needed")
+
+    def create_actor(self, *a, **kw):
+        self._unsupported("creating actors")
+
+    def submit_actor_task(self, *a, **kw):
+        self._unsupported("calling actor methods")
+
+    def kill_actor(self, *a, **kw):
+        self._unsupported("killing actors")
+
+    def create_placement_group(self, *a, **kw):
+        self._unsupported("creating placement groups")
+
+    def cluster_resources(self) -> dict:
+        return {}
+
+    def available_resources(self) -> dict:
+        return {}
+
+    def close(self) -> None:
+        self._client.close()
+
+
+_nested: Optional[NestedClient] = None
+_nested_lock = threading.Lock()
+
+
+def get_nested_client() -> Optional[NestedClient]:
+    """The current task's owner channel, or None outside a task."""
+    global _nested
+    from ray_tpu._private.worker_process import _CURRENT_TASK
+    addr = _CURRENT_TASK.get("owner_addr")
+    if addr is None:
+        return None
+    with _nested_lock:
+        if _nested is None or _nested._client.address != tuple(addr) \
+                or not _nested._client.alive:
+            if _nested is not None:
+                _nested.close()
+            _nested = NestedClient(tuple(addr),
+                                   _CURRENT_TASK.get("task_id", b""))
+        else:
+            _nested._task_id = _CURRENT_TASK.get("task_id", b"")
+        return _nested

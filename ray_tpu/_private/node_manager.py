@@ -475,6 +475,7 @@ class NodeManagerGroup:
             "return_ids": [o.binary() for o in spec.return_ids],
             "name": spec.repr_name(),
             "runtime_env": spec.runtime_env,
+            "owner_addr": self.object_server_addr,
             "resources": dict(spec.resources),
         }
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
@@ -1027,6 +1028,7 @@ class NodeManagerGroup:
             "return_ids": [o.binary() for o in spec.return_ids],
             "name": spec.repr_name(),
             "runtime_env": spec.runtime_env,
+            "owner_addr": self.object_server_addr,
         }
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             payload["actor_id"] = spec.actor_creation_id.binary()

@@ -97,6 +97,7 @@ class RayletServer:
         self.server.register("stats", lambda ctx: self.stats())
         self.server.register("submit", self._handle_submit)
         self.server.register("kill_actor", self._handle_kill_actor)
+        self.server.register("adjust_pool", self._handle_adjust_pool)
         self.server.register("shutdown", lambda ctx: self._request_shutdown())
 
         self._dispatch_thread = threading.Thread(
@@ -167,6 +168,13 @@ class RayletServer:
                 pass
             worker.kill()
             self.worker_pool.remove_worker(worker)
+
+    def _handle_adjust_pool(self, ctx, delta: int) -> None:
+        """Owner-directed worker-slot adjustment: a parent task blocked
+        in a nested get() lends its node one extra slot."""
+        with self._lock:
+            self.worker_pool._max_process += delta
+        self._wake.set()
 
     def _wake_dispatch(self) -> None:
         self._wake.set()

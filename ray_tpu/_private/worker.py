@@ -202,6 +202,7 @@ class Worker:
 
         from ray_tpu._private.stats import install_runtime_metrics
         install_runtime_metrics()
+        self._register_nested_handlers()
 
         if self._join_address is not None:
             self._attach_cluster_nodes()
@@ -417,6 +418,162 @@ class Worker:
         self.device_store.num_spilled_to_host += 1
         return self.shm_store.segment_for(oid)
 
+    # -- nested API served to in-task workers ---------------------------
+    #
+    # Workers are executors, but user code inside a task may call the
+    # public API (nested tasks, get, put, wait). Those calls ride an
+    # RPC channel from the worker back to this owner (reference: every
+    # Ray worker embeds a full CoreWorker; here the owner serves the
+    # core API surface to its workers — ownership of every object and
+    # task stays with the driver, so lineage/refcounting stay simple).
+
+    def _register_nested_handlers(self) -> None:
+        s = self.node_group.object_server
+        s.register("nested_submit", self._nested_submit)
+        s.register("nested_get", self._nested_get)
+        s.register("nested_put", self._nested_put)
+        s.register("nested_wait", self._nested_wait)
+
+    def _nested_submit(self, ctx, fid: bytes, fn_blob, fn_name: str,
+                       arg_descs, kwargs_keys, options_dict) -> List[bytes]:
+        if fn_blob is not None:
+            with self._functions_lock:
+                self._functions.setdefault(fid, fn_blob)
+        descriptor = FunctionDescriptor(function_id=fid, module="",
+                                        name=fn_name)
+        spec_args: List[TaskArg] = []
+        for d in arg_descs:
+            if d[0] == "v":
+                spec_args.append(TaskArg.by_value(d[1]))
+            else:
+                oid = ObjectID(d[1])
+                spec_args.append(TaskArg.by_ref(oid))
+                self.reference_counter.add_task_argument(oid)
+        opts = TaskOptions(**options_dict)
+        refs = self.submit_spec(descriptor, spec_args, list(kwargs_keys),
+                                opts)
+        out = []
+        for ref in refs:
+            # Pin on behalf of the borrowing worker (nested borrows are
+            # not individually tracked; released at job end).
+            self.reference_counter.add_local_reference(ref.id())
+            out.append(ref.binary())
+        return out
+
+    def _entry_blob(self, oid: ObjectID, entry: Entry):
+        """Entry -> ("val"|"err", serialized bytes) for shipping to a
+        worker (no driver-side deserialization)."""
+        if entry.kind == "err":
+            return ("err", entry.data)
+        if entry.kind == "blob":
+            return ("val", entry.data)
+        if entry.kind == "device":
+            if self._ensure_host_copy(oid) is None:
+                raise _LostObjectSignal(oid)
+        elif entry.kind == "remote":
+            if not self.node_group._localize_remote_entry(oid, entry):
+                raise _LostObjectSignal(oid)
+        view = self.shm_store.get_local(oid)
+        if view is None:
+            raise _LostObjectSignal(oid)
+        return ("val", bytes(view))
+
+    def _nested_get(self, ctx, task_id_b: bytes, oid_bytes_list,
+                    timeout):
+        release = self._release_blocked_parent(task_id_b)
+        try:
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            out = []
+            for ob in oid_bytes_list:
+                oid = ObjectID(ob)
+                while True:
+                    remaining = None
+                    if deadline is not None:
+                        remaining = max(0.0, deadline - time.monotonic())
+                    try:
+                        entry = self.memory_store.get(oid, remaining)
+                    except TimeoutError:
+                        return ("timeout", None)
+                    try:
+                        out.append(self._entry_blob(oid, entry))
+                        break
+                    except _LostObjectSignal:
+                        if not self._recover_object(oid):
+                            err = ObjectLostError(
+                                f"object {oid} was lost and cannot be "
+                                "reconstructed")
+                            out.append(("err",
+                                        self.serde.serialize(err)
+                                        .to_bytes()))
+                            break
+            return ("ok", out)
+        finally:
+            release()
+
+    def _nested_put(self, ctx, blob: bytes) -> bytes:
+        cfg = get_config()
+        oid = self.next_put_id()
+        if len(blob) <= cfg.max_direct_call_object_size:
+            entry = Entry("blob", blob)
+        else:
+            self.shm_store.put_blob(oid, bytes(blob))
+            from ray_tpu._private.object_store import _segment_name
+            entry = Entry("shm",
+                          (_segment_name(self.session, oid), len(blob)))
+        self.reference_counter.add_owned_object(oid)
+        self.reference_counter.add_local_reference(oid)   # worker pin
+        self._store_result(oid, entry)
+        return oid.binary()
+
+    def _nested_wait(self, ctx, oid_bytes_list, num_returns, timeout):
+        ids = [ObjectID(b) for b in oid_bytes_list]
+        ready, _ = self.memory_store.wait(ids, num_returns, timeout)
+        return [oid.binary() for oid in ready]
+
+    def _release_blocked_parent(self, task_id_b: bytes):
+        """A parent task blocking on get() releases its resource
+        allocation and lends its node one extra worker slot, so child
+        tasks can run even at pool capacity (the reference's
+        CPU-release-while-blocked deadlock avoidance). Returns the
+        restore callback."""
+        if not task_id_b:
+            return lambda: None
+        ng = self.node_group
+        tid = TaskID(task_id_b)
+        with ng._lock:
+            rt = ng._running.get(tid)
+            if rt is None:
+                return lambda: None
+            resources, pg = rt.resources, rt.pg
+            rt.resources, rt.pg = {}, None
+            raylet = ng._raylets.get(rt.node_id)
+            handle = ng._remote_nodes.get(rt.node_id)
+        if resources:
+            ng._free_allocation(rt.node_id, resources, pg)
+        if raylet is not None:
+            with ng._lock:
+                raylet.worker_pool._max_process += 1
+            ng._wake.set()
+
+            def release():
+                with ng._lock:
+                    raylet.worker_pool._max_process -= 1
+            return release
+        if handle is not None:
+            try:
+                handle.client.oneway("adjust_pool", 1)
+            except Exception:
+                pass
+
+            def release():
+                try:
+                    handle.client.oneway("adjust_pool", -1)
+                except Exception:
+                    pass
+            return release
+        return lambda: None
+
     # -- lineage reconstruction ----------------------------------------
 
     def _object_live(self, oid: ObjectID) -> bool:
@@ -507,10 +664,16 @@ class Worker:
 
     def submit_task(self, fn_descriptor: FunctionDescriptor, args: tuple,
                     kwargs: dict, options: TaskOptions) -> List[ObjectRef]:
-        cfg = get_config()
-        task_id = self.next_task_id()
         spec_args: List[TaskArg] = []
         kwargs_keys = self.build_args(args, kwargs, spec_args)
+        return self.submit_spec(fn_descriptor, spec_args, kwargs_keys,
+                                options)
+
+    def submit_spec(self, fn_descriptor: FunctionDescriptor,
+                    spec_args: List[TaskArg], kwargs_keys: List[str],
+                    options: TaskOptions) -> List[ObjectRef]:
+        cfg = get_config()
+        task_id = self.next_task_id()
         num_returns = options.num_returns
         return_ids = [ObjectID.from_index(task_id, i + 1)
                       for i in range(num_returns)]
@@ -857,6 +1020,7 @@ class Worker:
             "return_ids": [o.binary() for o in spec.return_ids],
             "name": spec.repr_name(),
             "runtime_env": spec.runtime_env,
+            "owner_addr": self.node_group.object_server_addr,
         }
         return payload, None
 
@@ -954,17 +1118,24 @@ _global_lock = threading.Lock()
 def init(**kwargs) -> Worker:
     global _global_worker
     if os.environ.get("RAY_TPU_WORKER_MODE") == "1":
+        nested = _nested_client()
+        if nested is not None:
+            return nested
         raise RuntimeError(
-            "ray_tpu API calls inside task/actor workers are not "
-            "supported: workers are pure executors in this runtime. "
-            "Submit follow-up work from the driver (e.g. chain tasks "
-            "on returned ObjectRefs).")
+            "ray_tpu API calls inside task/actor workers need an owner "
+            "channel and none is attached (workers are pure executors; "
+            "nested calls are served by the task's owner).")
     with _global_lock:
         if _global_worker is not None:
             return _global_worker
         _global_worker = Worker(**kwargs)
         atexit.register(shutdown)
         return _global_worker
+
+
+def _nested_client():
+    from ray_tpu._private.nested_client import get_nested_client
+    return get_nested_client()
 
 
 def shutdown() -> None:
@@ -977,6 +1148,8 @@ def shutdown() -> None:
 
 def global_worker() -> Worker:
     if _global_worker is None:
+        if os.environ.get("RAY_TPU_WORKER_MODE") == "1":
+            return init()      # resolves to the nested-call client
         init()
     return _global_worker
 

@@ -47,6 +47,10 @@ from ray_tpu._private.object_store import (
 )
 from ray_tpu.exceptions import TaskError
 
+# Per-process pointer at the currently-executing task's owner channel
+# (process workers run one task at a time).
+_CURRENT_TASK: Dict[str, Any] = {"owner_addr": None, "task_id": b""}
+
 
 class ExecutionEnv:
     """Per-worker execution state: function cache, shm access, session."""
@@ -139,6 +143,10 @@ class ExecutionEnv:
     def execute(self, payload: dict) -> tuple:
         """Run one task payload; returns a ("done", ...) message."""
         task_id = payload["task_id"]
+        # Expose the owner channel + identity to nested API calls made
+        # by the user function (see _private/nested_client.py).
+        _CURRENT_TASK["owner_addr"] = payload.get("owner_addr")
+        _CURRENT_TASK["task_id"] = task_id
         try:
             fn = self._get_callable(payload)
             args, kwargs = self.resolve_args(payload["args"],

@@ -252,6 +252,24 @@ def test_lost_remote_object_reconstructs(ray_start_cluster):
     assert cluster.worker.task_manager.num_reconstructions >= 1
 
 
+def test_nested_submission_from_remote_raylet(ray_start_cluster):
+    """A task on a raylet process submits child tasks back through its
+    owner channel; children run wherever the scheduler places them."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"N": 2}, remote=True)
+
+    @ray_tpu.remote
+    def child(i):
+        return i + 1
+
+    @ray_tpu.remote(num_cpus=1, resources={"N": 1})
+    def parent():
+        import ray_tpu as rt
+        return sum(rt.get([child.remote(i) for i in range(3)]))
+
+    assert ray_tpu.get(parent.remote(), timeout=180) == 6
+
+
 def test_remote_actor_lifecycle(ray_start_cluster):
     cluster = ray_start_cluster
     cluster.add_node(num_cpus=2, resources={"ACT": 1}, remote=True)

@@ -1,0 +1,92 @@
+"""Nested task submission from inside workers.
+
+Reference analog: core Ray semantics — ``ray.remote/get/put/wait``
+work anywhere because every worker embeds a CoreWorker
+(``python/ray/tests/test_basic.py`` nested patterns) [UNVERIFIED —
+mount empty, SURVEY.md §0]. Here the owner serves the API to its
+workers over the nested channel; a blocked parent releases resources
+and lends a worker slot (deadlock avoidance).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_nested_fan_out(ray_start_regular):
+    @ray_tpu.remote
+    def child(i):
+        return i * 10
+
+    @ray_tpu.remote
+    def parent(n):
+        import ray_tpu as rt
+        refs = [child.remote(i) for i in range(n)]
+        return sum(rt.get(refs))
+
+    assert ray_tpu.get(parent.remote(4), timeout=180) == 60
+
+
+def test_nested_recursion_with_blocking_parents(ray_start_regular):
+    """Multiple levels of parents blocked in get() at once — the pool
+    must lend slots or this deadlocks at max_process_workers=2."""
+
+    @ray_tpu.remote
+    def fib(n):
+        if n < 2:
+            return n
+        import ray_tpu as rt
+        return sum(rt.get([fib.remote(n - 1), fib.remote(n - 2)]))
+
+    assert ray_tpu.get(fib.remote(5), timeout=300) == 5
+
+
+def test_nested_put_and_ref_passing(ray_start_regular):
+    @ray_tpu.remote
+    def total(x):
+        return float(np.asarray(x).sum())
+
+    @ray_tpu.remote
+    def parent():
+        import ray_tpu as rt
+        big = np.ones(200_000)
+        ref = rt.put(big)
+        return rt.get(total.remote(ref))
+
+    assert ray_tpu.get(parent.remote(), timeout=180) == 200_000.0
+
+
+def test_nested_wait(ray_start_regular):
+    @ray_tpu.remote
+    def quick(i):
+        return i
+
+    @ray_tpu.remote
+    def parent():
+        import ray_tpu as rt
+        refs = [quick.remote(i) for i in range(3)]
+        ready, not_ready = rt.wait(refs, num_returns=3, timeout=120)
+        return len(ready), len(not_ready)
+
+    assert ray_tpu.get(parent.remote(), timeout=180) == (3, 0)
+
+
+def test_nested_actor_calls_raise_clearly(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def f(self):
+            return 1
+
+    @ray_tpu.remote
+    def tries_actor():
+        import ray_tpu as rt
+
+        @rt.remote
+        class B:
+            pass
+
+        B.remote()
+
+    with pytest.raises(NotImplementedError, match="creating actors"):
+        ray_tpu.get(tries_actor.remote(), timeout=120)

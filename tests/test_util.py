@@ -74,17 +74,17 @@ def test_queue_blocking_get(ray_start_regular):
     q.shutdown()
 
 
-def test_nested_api_calls_raise_clearly(ray_start_regular):
-    """Workers are pure executors: in-task ray_tpu usage surfaces a
-    clear error, not a nested runtime."""
+def test_in_task_init_returns_nested_client(ray_start_regular):
+    """init() inside a worker resolves to the owner-served nested-call
+    client, never a second runtime."""
 
     @ray_tpu.remote
     def nested():
         import ray_tpu as rt
-        rt.init()
+        w = rt.init()
+        return type(w).__name__
 
-    with pytest.raises(RuntimeError, match="pure executors"):
-        ray_tpu.get(nested.remote())
+    assert ray_tpu.get(nested.remote(), timeout=120) == "NestedClient"
 
 
 def test_metrics_counter_gauge_histogram():
