@@ -1,0 +1,126 @@
+"""Dashboard-lite: HTTP view over the state API + metrics.
+
+Reference: ``python/ray/dashboard/`` (aiohttp head + React SPA)
+[UNVERIFIED — mount empty, SURVEY.md §0]. The aggregation layer is
+what matters architecturally — GCS + scheduler + store state behind
+HTTP — so this serves the state API as JSON plus the Prometheus
+endpoint and a minimal HTML overview, in the driver process:
+
+  GET /                 HTML overview (auto-refreshing)
+  GET /api/summary      cluster summary
+  GET /api/nodes|actors|tasks|objects|workers
+  GET /metrics          Prometheus exposition
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>body{font-family:monospace;margin:2em}table{border-collapse:
+collapse}td,th{border:1px solid #999;padding:4px 8px;text-align:left}
+h2{margin-top:1.2em}</style></head><body>
+<h1>ray_tpu</h1><div id="content">%s</div></body></html>"""
+
+
+def _table(rows) -> str:
+    if not rows:
+        return "<p>none</p>"
+    cols = list(rows[0].keys())
+    out = ["<table><tr>"] + [f"<th>{c}</th>" for c in cols] + ["</tr>"]
+    for r in rows:
+        out.append("<tr>" + "".join(
+            f"<td>{r.get(c, '')}</td>" for c in cols) + "</tr>")
+    out.append("</table>")
+    return "".join(out)
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from ray_tpu.util import metrics, state
+        dash = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: ANN002
+                pass
+
+            def _send(self, body: bytes, ctype: str) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.rstrip("/")
+                try:
+                    if path == "/metrics":
+                        self._send(metrics.prometheus_text().encode(),
+                                   "text/plain; version=0.0.4")
+                    elif path == "/api/summary":
+                        self._send(json.dumps(state.summary()).encode(),
+                                   "application/json")
+                    elif path.startswith("/api/"):
+                        kind = path[len("/api/"):]
+                        fn = getattr(state, f"list_{kind}", None)
+                        if fn is None:
+                            self.send_error(404, f"unknown api {kind!r}")
+                            return
+                        self._send(json.dumps(fn()).encode(),
+                                   "application/json")
+                    elif path in ("", "/"):
+                        body = []
+                        body.append("<h2>summary</h2><pre>%s</pre>"
+                                    % json.dumps(state.summary(),
+                                                 indent=2))
+                        body.append("<h2>nodes</h2>"
+                                    + _table(state.list_nodes()))
+                        body.append("<h2>actors</h2>"
+                                    + _table(state.list_actors()))
+                        tasks = state.list_tasks()
+                        body.append(f"<h2>tasks ({len(tasks)})</h2>"
+                                    + _table(tasks[-50:]))
+                        self._send((_PAGE % "".join(body)).encode(),
+                                   "text/html")
+                    else:
+                        self.send_error(404)
+                except Exception as e:  # noqa: BLE001
+                    self.send_error(500, str(e)[:300])
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.address: Tuple[str, int] = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.2}, daemon=True,
+            name="rtpu-dashboard")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+
+
+_dashboard: Optional[Dashboard] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0
+                    ) -> Tuple[str, int]:
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port)
+    return _dashboard.address
+
+
+def stop_dashboard() -> None:
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.shutdown()
+        _dashboard = None

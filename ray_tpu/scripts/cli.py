@@ -107,6 +107,37 @@ def _cmd_stop(args) -> int:
     return 0
 
 
+def _cmd_job(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+    client = JobSubmissionClient(args.address)
+    try:
+        if args.job_command == "submit":
+            entrypoint = " ".join(args.entrypoint).lstrip("- ")
+            job_id = client.submit_job(entrypoint=entrypoint)
+            print(f"submitted {job_id}: {entrypoint}")
+            if not args.no_wait:
+                info = client.wait_until_finished(job_id,
+                                                  timeout=args.timeout)
+                print(client.get_job_logs(job_id), end="")
+                print(f"{job_id} {info.status} (rc={info.return_code})")
+                return 0 if info.status == "SUCCEEDED" else 1
+            return 0
+        if args.job_command == "list":
+            for info in client.list_jobs():
+                print(f"{info.job_id:20} {info.status:10} "
+                      f"{info.entrypoint}")
+            return 0
+        if args.job_command == "status":
+            print(client.get_job_status(args.job_id))
+            return 0
+        if args.job_command == "logs":
+            print(client.get_job_logs(args.job_id), end="")
+            return 0
+        return 2
+    finally:
+        client.close()
+
+
 def _cmd_workflows(args) -> int:
     from ray_tpu import workflow
     rows = workflow.list_all(args.storage)
@@ -145,6 +176,21 @@ def main(argv=None) -> int:
     sp = sub.add_parser("workflows", help="list workflows")
     sp.add_argument("--storage", default=None)
     sp.set_defaults(fn=_cmd_workflows)
+
+    sp = sub.add_parser("job", help="submit/track jobs")
+    jsub = sp.add_subparsers(dest="job_command", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--address", required=True)
+    js.add_argument("--no-wait", action="store_true")
+    js.add_argument("--timeout", type=float, default=600.0)
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    js.set_defaults(fn=_cmd_job)
+    for name in ("list", "status", "logs"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("--address", required=True)
+        if name in ("status", "logs"):
+            jp.add_argument("job_id")
+        jp.set_defaults(fn=_cmd_job)
 
     args = p.parse_args(argv)
     return args.fn(args)
