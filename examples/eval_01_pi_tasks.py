@@ -1,0 +1,43 @@
+"""BASELINE eval config 1: N embarrassingly-parallel pi-estimation
+tasks (``BASELINE.json:7``). Prints one JSON line with throughput.
+
+    python examples/eval_01_pi_tasks.py [--n 10000] [--samples 10000]
+"""
+
+import argparse
+import json
+import time
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def pi_sample(n: int, seed: int) -> int:
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    xy = rng.uniform(-1, 1, (n, 2))
+    return int((np.einsum("ij,ij->i", xy, xy) <= 1.0).sum())
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=10_000)
+    p.add_argument("--samples", type=int, default=10_000)
+    args = p.parse_args()
+
+    ray_tpu.init(num_cpus=8, max_process_workers=4)
+    t0 = time.perf_counter()
+    refs = [pi_sample.remote(args.samples, i) for i in range(args.n)]
+    hits = sum(ray_tpu.get(refs))
+    dt = time.perf_counter() - t0
+    pi = 4.0 * hits / (args.n * args.samples)
+    print(json.dumps({
+        "metric": "pi_tasks_per_sec", "value": round(args.n / dt, 1),
+        "unit": "tasks/s", "n_tasks": args.n, "pi": round(pi, 5),
+        "wall_s": round(dt, 2),
+    }))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

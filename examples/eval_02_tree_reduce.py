@@ -1,0 +1,52 @@
+"""BASELINE eval config 2: N-task dependency DAG — recursive
+tree-reduce over ObjectRef deps (``BASELINE.json:8``).
+
+    python examples/eval_02_tree_reduce.py [--leaves 1024]
+"""
+
+import argparse
+import json
+import time
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def leaf(i: int) -> int:
+    return i
+
+
+@ray_tpu.remote
+def combine(a: int, b: int) -> int:
+    return a + b
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--leaves", type=int, default=1024)
+    args = p.parse_args()
+
+    ray_tpu.init()
+    t0 = time.perf_counter()
+    refs = [leaf.remote(i) for i in range(args.leaves)]
+    n_tasks = len(refs)
+    while len(refs) > 1:
+        nxt = [combine.remote(refs[i], refs[i + 1])
+               for i in range(0, len(refs) - 1, 2)]
+        if len(refs) % 2:
+            nxt.append(refs[-1])
+        refs = nxt
+        n_tasks += len(refs)
+    total = ray_tpu.get(refs[0])
+    dt = time.perf_counter() - t0
+    assert total == sum(range(args.leaves))
+    print(json.dumps({
+        "metric": "tree_reduce_tasks_per_sec",
+        "value": round(n_tasks / dt, 1), "unit": "tasks/s",
+        "n_tasks": n_tasks, "wall_s": round(dt, 2),
+    }))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

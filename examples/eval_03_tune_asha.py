@@ -1,0 +1,53 @@
+"""BASELINE eval config 3: Tune ASHA sweep over gang-scheduled trials
+(``BASELINE.json:9``; 1k trials at full scale).
+
+    python examples/eval_03_tune_asha.py [--trials 32]
+"""
+
+import argparse
+import json
+import time
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.schedulers import ASHAScheduler
+
+
+def trainable(config):
+    score = 0.0
+    for i in range(1, 9):
+        score = config["lr"] * i - config["decay"] * i * i
+        tune.report({"score": score, "training_iteration": i})
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--trials", type=int, default=32)
+    args = p.parse_args()
+
+    ray_tpu.init(num_cpus=8, max_process_workers=4)
+    t0 = time.perf_counter()
+    grid = Tuner(
+        trainable,
+        param_space={"lr": tune.uniform(0.1, 2.0),
+                     "decay": tune.uniform(0.0, 0.1)},
+        tune_config=TuneConfig(
+            num_samples=args.trials, metric="score", mode="max",
+            scheduler=ASHAScheduler(metric="score", mode="max",
+                                    max_t=8, grace_period=2),
+            max_concurrent_trials=4),
+    ).fit()
+    dt = time.perf_counter() - t0
+    best = grid.get_best_result()
+    print(json.dumps({
+        "metric": "asha_trials_per_min",
+        "value": round(args.trials / dt * 60, 1), "unit": "trials/min",
+        "n_trials": args.trials, "best_score": round(
+            best.metrics["score"], 3), "wall_s": round(dt, 2),
+    }))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

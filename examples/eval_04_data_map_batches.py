@@ -1,0 +1,54 @@
+"""BASELINE eval config 4: streaming map_batches over parquet blocks
+(``BASELINE.json:10``; 1k blocks at full scale).
+
+    python examples/eval_04_data_map_batches.py [--blocks 64]
+"""
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--blocks", type=int, default=64)
+    p.add_argument("--rows-per-block", type=int, default=4096)
+    args = p.parse_args()
+
+    ray_tpu.init()
+    with tempfile.TemporaryDirectory() as d:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        paths = []
+        for i in range(args.blocks):
+            path = os.path.join(d, f"part_{i:05d}.parquet")
+            pq.write_table(pa.table({
+                "x": np.random.rand(args.rows_per_block),
+                "id": np.arange(args.rows_per_block) + i * 100000,
+            }), path)
+            paths.append(path)
+
+        t0 = time.perf_counter()
+        ds = rdata.read_parquet(paths)
+        out = (ds.map_batches(lambda b: {"y": b["x"] * 2.0})
+                 .sum("y"))
+        dt = time.perf_counter() - t0
+        rows = args.blocks * args.rows_per_block
+        print(json.dumps({
+            "metric": "map_batches_rows_per_sec",
+            "value": round(rows / dt, 1), "unit": "rows/s",
+            "blocks": args.blocks, "rows": rows,
+            "sum_y": round(float(out), 2), "wall_s": round(dt, 2),
+        }))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

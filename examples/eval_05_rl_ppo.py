@@ -1,0 +1,48 @@
+"""BASELINE eval config 5: PPO with rollout-worker actors and
+heterogeneous resource shapes (``BASELINE.json:11``; 256 rollout
+actors at full scale).
+
+    python examples/eval_05_rl_ppo.py [--runners 4] [--iters 10]
+"""
+
+import argparse
+import json
+import time
+
+import ray_tpu
+from ray_tpu.rl import PPOConfig
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--runners", type=int, default=4)
+    p.add_argument("--envs-per-runner", type=int, default=16)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+
+    ray_tpu.init(num_cpus=args.runners + 2,
+                 max_process_workers=args.runners + 1)
+    algo = (PPOConfig()
+            .environment("CartPole")
+            .env_runners(num_env_runners=args.runners,
+                         num_envs_per_runner=args.envs_per_runner,
+                         rollout_length=128)
+            .build())
+    t0 = time.perf_counter()
+    result = {}
+    for _ in range(args.iters):
+        result = algo.train()
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "ppo_env_steps_per_sec",
+        "value": round(result["num_env_steps_sampled"] / dt, 1),
+        "unit": "steps/s",
+        "episode_return_mean": round(result["episode_return_mean"], 1),
+        "iters": args.iters, "wall_s": round(dt, 2),
+    }))
+    algo.stop()
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
