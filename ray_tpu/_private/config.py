@@ -102,6 +102,9 @@ _D("tpu_scheduler_batch_size", int, 512,
 _D("tpu_scheduler_min_batch", int, 64,
    "Pending-queue depth below which the adaptive policy uses the native "
    "CPU scan (no device round-trip floor) instead of the TPU kernel.")
+_D("pg_kernel_min_work", int, 4096,
+   "bundles x nodes product above which placement-group packing uses "
+   "the jitted assignment kernel (accelerator hosts only).")
 _D("use_tpu_scheduler", str, "auto",
    "Select the TPU policy in the ISchedulingPolicy registry: "
    "'auto' (default) uses it whenever an accelerator backend is "

@@ -72,6 +72,8 @@ class PlacementGroupManager:
         self._lock = threading.RLock()
         self._groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self._pending: List[PlacementGroupID] = []
+        self._kernel_solver = None   # lazy jitted bin-packer
+        self.num_kernel_solves = 0
 
     # -- creation / removal ------------------------------------------------
 
@@ -165,11 +167,38 @@ class PlacementGroupManager:
             except Exception:
                 logger.exception("pg on_created callback failed")
 
+    def _try_kernel_solve(self, info: PlacementGroupInfo
+                          ) -> Optional[List[NodeID]]:
+        """The jitted assignment solve (BASELINE.json:5) for big
+        bundle × node products on accelerator hosts; None defers to
+        the Python greedy (which also owns infeasibility marking)."""
+        from ray_tpu._private.config import get_config
+        work = len(info.bundles) * self._cluster.num_nodes()
+        if work < get_config().pg_kernel_min_work:
+            return None
+        from ray_tpu._private.scheduler.policy import _tpu_scheduler_enabled
+        if not _tpu_scheduler_enabled():
+            return None
+        try:
+            if self._kernel_solver is None:
+                from ray_tpu._private.scheduler.pg_kernel import (
+                    PgKernelSolver)
+                self._kernel_solver = PgKernelSolver()
+            return self._kernel_solver.solve(self._cluster, info.bundles,
+                                             info.strategy)
+        except Exception:
+            logger.exception("pg kernel solve failed; python fallback")
+            return None
+
     def _solve(self, info: PlacementGroupInfo
                ) -> Optional[List[NodeID]]:
         """Trial assignment of bundles -> nodes on a snapshot; None if it
         doesn't fit right now. Sets ``is_infeasible`` when it can never
         fit the current node set."""
+        kernel_assignment = self._try_kernel_solve(info)
+        if kernel_assignment is not None:
+            self.num_kernel_solves += 1
+            return kernel_assignment
         view = self._cluster.snapshot()
         alive = {nid: n for nid, n in view.items() if n.alive}
         strategy = info.strategy

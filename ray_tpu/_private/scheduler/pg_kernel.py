@@ -1,0 +1,117 @@
+"""Placement-group bundle packing as a jitted assignment solve.
+
+The second half of the north-star mechanism (BASELINE.json:5): the
+reference's ``GcsPlacementGroupScheduler`` bin-packs bundles onto nodes
+with per-bundle scalar scans (``policy/bundle_scheduling_policy.cc``
+[UNVERIFIED — mount empty, SURVEY.md §0]). Here one device program
+scans the bundle list with a carried availability matrix — per bundle,
+feasibility masking and utilization scoring are vectorized over ALL
+nodes (VPU), and the whole solve is a single launch with ONE
+device-to-host transfer for the assignment.
+
+Strategies: PACK (most-utilized feasible node first — co-locates),
+SPREAD (least-utilized, preferring nodes unused by this group),
+STRICT_SPREAD (distinct node per bundle, hard), STRICT_PACK (the
+bundle-sum must fit one node).
+
+Used by ``PlacementGroupManager`` when bundles × nodes crosses
+``pg_kernel_min_work`` and an accelerator backend is present; the
+Python greedy stays the small-group/CPU path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-6
+_SPREAD_PENALTY = 1e3
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def _pack_kernel(avail, total, alive, demands, mode: str):
+    """avail/total [N,R] f32, alive [N] bool, demands [B,R] f32 ->
+    packed int32 [B+1]: per-bundle node index (-1 = unplaced) + ok
+    flag. One output array = one d2h transfer."""
+    n = avail.shape[0]
+
+    def step(carry, demand):
+        avail, used = carry
+        has = demand > 0.0
+        can = alive & jnp.all(
+            jnp.where(has[None, :], avail + _EPS >= demand[None, :], True),
+            axis=1)
+        util = jnp.max(
+            jnp.where(total > 0.0,
+                      (total - avail) / jnp.maximum(total, _EPS), 0.0),
+            axis=1)
+        if mode == "pack":
+            score = -util                       # fullest first
+        elif mode == "spread":
+            score = util + jnp.where(used, _SPREAD_PENALTY, 0.0)
+        else:  # strict_spread
+            score = util
+            can = can & ~used
+        score = jnp.where(can, score, jnp.inf)
+        idx = jnp.argmin(score)
+        ok = can[idx]
+        avail = avail - jnp.zeros_like(avail).at[idx].set(
+            jnp.where(ok, demand, 0.0))
+        used = used.at[idx].set(used[idx] | ok)
+        return (avail, used), jnp.where(ok, idx, -1).astype(jnp.int32)
+
+    (_, _), assign = jax.lax.scan(
+        step, (avail, jnp.zeros((n,), bool)), demands)
+    ok_all = jnp.all(assign >= 0).astype(jnp.int32)
+    return jnp.concatenate([assign, ok_all[None]])
+
+
+class PgKernelSolver:
+    """Host wrapper: dense view + strategy dispatch."""
+
+    def __init__(self):
+        from ray_tpu._private.scheduler.tpu_policy import _DenseView
+        self._view = _DenseView()
+
+    def solve(self, cluster, bundles: List[Dict[str, float]],
+              strategy: str) -> Optional[List]:
+        """Bundle -> NodeID assignment, or None when it doesn't fit
+        right now (caller falls back for infeasibility marking)."""
+        view = self._view
+        view.refresh(cluster,
+                     extra_resources=[r for b in bundles for r in b])
+        if not view.node_ids:
+            return None
+
+        if strategy == "STRICT_PACK":
+            total_demand: Dict[str, float] = {}
+            for b in bundles:
+                for k, v in b.items():
+                    total_demand[k] = total_demand.get(k, 0.0) + v
+            demands = np.stack([view.demand_vector(total_demand)])
+            mode = "spread"     # least-utilized single node with room
+        else:
+            demands = np.stack([view.demand_vector(b) for b in bundles]) \
+                if bundles else np.zeros((0, view.total.shape[1]),
+                                         np.float32)
+            mode = {"PACK": "pack", "SPREAD": "spread",
+                    "STRICT_SPREAD": "strict_spread"}[strategy]
+
+        packed = np.asarray(_pack_kernel(
+            jnp.asarray(view.avail, jnp.float32),
+            jnp.asarray(view.total, jnp.float32),
+            jnp.asarray(view.alive),
+            jnp.asarray(demands, jnp.float32),
+            mode))
+        assign, ok = packed[:-1], bool(packed[-1])
+        if not ok:
+            return None
+        if strategy == "STRICT_PACK":
+            nid = view.node_ids[int(assign[0])]
+            return [nid] * len(bundles)
+        return [view.node_ids[int(i)] for i in assign]

@@ -1,0 +1,104 @@
+"""Jitted placement-group bin-pack kernel tests (BASELINE.json:5's
+second mechanism: PG packing as an assignment solve on the device)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.scheduler.pg_kernel import PgKernelSolver
+from ray_tpu._private.scheduler.resources import (
+    ClusterResourceManager,
+    NodeResources,
+)
+
+
+def _cluster(specs):
+    cluster = ClusterResourceManager()
+    ids = []
+    for total in specs:
+        nid = NodeID.from_random()
+        cluster.add_or_update_node(
+            nid, NodeResources(total=dict(total), available=dict(total)))
+        ids.append(nid)
+    return cluster, ids
+
+
+def test_pack_colocates():
+    cluster, _ = _cluster([{"CPU": 8}, {"CPU": 8}, {"CPU": 8}])
+    solver = PgKernelSolver()
+    assign = solver.solve(cluster, [{"CPU": 2}] * 3, "PACK")
+    assert assign is not None
+    assert len(set(assign)) == 1          # all on one node
+
+
+def test_spread_distributes():
+    cluster, _ = _cluster([{"CPU": 8}] * 4)
+    solver = PgKernelSolver()
+    assign = solver.solve(cluster, [{"CPU": 2}] * 4, "SPREAD")
+    assert assign is not None
+    assert len(set(assign)) == 4          # one per node
+
+
+def test_strict_spread_requires_distinct_nodes():
+    cluster, _ = _cluster([{"CPU": 8}] * 2)
+    solver = PgKernelSolver()
+    assert solver.solve(cluster, [{"CPU": 1}] * 3, "STRICT_SPREAD") is None
+    assign = solver.solve(cluster, [{"CPU": 1}] * 2, "STRICT_SPREAD")
+    assert assign is not None and len(set(assign)) == 2
+
+
+def test_strict_pack_single_node():
+    cluster, ids = _cluster([{"CPU": 2}, {"CPU": 16}])
+    solver = PgKernelSolver()
+    assign = solver.solve(cluster, [{"CPU": 4}] * 3, "STRICT_PACK")
+    assert assign is not None
+    assert set(assign) == {ids[1]}        # only the big node fits 12
+    assert solver.solve(cluster, [{"CPU": 10}] * 3, "STRICT_PACK") is None
+
+
+@pytest.mark.parametrize("strategy", ["PACK", "SPREAD", "STRICT_SPREAD"])
+def test_kernel_assignments_respect_capacity(strategy):
+    rng = np.random.RandomState(0)
+    specs = [{"CPU": float(rng.choice([4, 8, 16])),
+              "memory": float(rng.choice([32, 64]))} for _ in range(32)]
+    cluster, _ = _cluster(specs)
+    bundles = [{"CPU": float(rng.choice([1, 2])),
+                "memory": float(rng.choice([4, 8]))} for _ in range(16)]
+    solver = PgKernelSolver()
+    assign = solver.solve(cluster, bundles, strategy)
+    assert assign is not None
+    usage = {}
+    for nid, b in zip(assign, bundles):
+        u = usage.setdefault(nid, {})
+        for k, v in b.items():
+            u[k] = u.get(k, 0.0) + v
+    view = {nid: res for nid, res in cluster.nodes()}
+    for nid, u in usage.items():
+        for k, v in u.items():
+            assert v <= view[nid].total[k] + 1e-6
+    if strategy == "STRICT_SPREAD":
+        assert len(set(assign)) == len(bundles)
+
+
+def test_manager_uses_kernel_above_threshold(ray_start_cluster):
+    """PlacementGroupManager routes big solves through the kernel when
+    the TPU scheduler is enabled."""
+    import ray_tpu
+    from ray_tpu.util.placement_group import (
+        placement_group, remove_placement_group)
+
+    cluster = ray_start_cluster
+    for _ in range(3):
+        cluster.add_node(num_cpus=8)
+    cfg = get_config()
+    cfg.apply_system_config({"pg_kernel_min_work": 1,
+                             "use_tpu_scheduler": "1"})
+    try:
+        pg = placement_group([{"CPU": 1}] * 4, strategy="SPREAD")
+        ray_tpu.get(pg.ready(), timeout=60)
+        assert cluster.worker.pg_manager.num_kernel_solves >= 1
+        remove_placement_group(pg)
+    finally:
+        cfg.apply_system_config({"pg_kernel_min_work": 4096,
+                                 "use_tpu_scheduler": "auto"})
