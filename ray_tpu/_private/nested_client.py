@@ -34,6 +34,9 @@ from ray_tpu.exceptions import GetTimeoutError, TaskError
 _SHIPPED_OPTION_FIELDS = (
     "num_cpus", "num_tpus", "num_gpus", "memory", "resources",
     "num_returns", "max_retries", "name")
+_SHIPPED_ACTOR_FIELDS = _SHIPPED_OPTION_FIELDS + (
+    "max_restarts", "max_task_retries", "namespace", "get_if_exists",
+    "lifetime")
 
 
 class _NoopRefCounter:
@@ -74,8 +77,7 @@ class NestedClient:
 
     # -- task submission -----------------------------------------------
 
-    def submit_task(self, fn_descriptor: FunctionDescriptor, args: tuple,
-                    kwargs: dict, options: TaskOptions) -> List[ObjectRef]:
+    def _ser_args(self, args: tuple, kwargs: dict):
         kwargs_keys = list(kwargs.keys())
         arg_descs = []
         for value in list(args) + [kwargs[k] for k in kwargs_keys]:
@@ -84,17 +86,24 @@ class NestedClient:
             else:
                 arg_descs.append(
                     ("v", self.serde.serialize(value).to_bytes()))
+        return arg_descs, kwargs_keys
+
+    def _fn_shipment(self, fid: bytes):
+        with self._fn_lock:
+            if fid in self._shipped_fids:
+                return None
+            self._shipped_fids.add(fid)
+            return self._fn_blobs.get(fid)
+
+    def submit_task(self, fn_descriptor: FunctionDescriptor, args: tuple,
+                    kwargs: dict, options: TaskOptions) -> List[ObjectRef]:
+        arg_descs, kwargs_keys = self._ser_args(args, kwargs)
         options_dict = {f: getattr(options, f)
                         for f in _SHIPPED_OPTION_FIELDS}
         fid = fn_descriptor.function_id
-        blob = None
-        with self._fn_lock:
-            if fid not in self._shipped_fids:
-                blob = self._fn_blobs.get(fid)
-                self._shipped_fids.add(fid)
         refs_b = self._client.call(
-            "nested_submit", fid, blob, fn_descriptor.name, arg_descs,
-            kwargs_keys, options_dict)
+            "nested_submit", fid, self._fn_shipment(fid),
+            fn_descriptor.name, arg_descs, kwargs_keys, options_dict)
         return [ObjectRef(ObjectID(b)) for b in refs_b]
 
     # -- object plane ----------------------------------------------------
@@ -135,21 +144,51 @@ class NestedClient:
              else not_ready).append(r)
         return ready, not_ready
 
+    # -- actors ----------------------------------------------------------
+
+    def create_actor(self, fn_descriptor: FunctionDescriptor,
+                     args: tuple, kwargs: dict, options: TaskOptions,
+                     class_name: str):
+        from ray_tpu._private.ids import ActorID
+        arg_descs, kwargs_keys = self._ser_args(args, kwargs)
+        options_dict = {f: getattr(options, f)
+                        for f in _SHIPPED_ACTOR_FIELDS}
+        options_dict.pop("num_returns", None)
+        fid = fn_descriptor.function_id
+        actor_id_b = self._client.call(
+            "nested_create_actor", fid, self._fn_shipment(fid),
+            class_name, arg_descs, kwargs_keys, options_dict)
+        return ActorID(actor_id_b)
+
+    def submit_actor_task(self, actor_id, method_name: str, args: tuple,
+                          kwargs: dict, options: TaskOptions
+                          ) -> List[ObjectRef]:
+        arg_descs, kwargs_keys = self._ser_args(args, kwargs)
+        options_dict = {"num_returns": options.num_returns}
+        refs_b = self._client.call(
+            "nested_actor_task", actor_id.binary(), method_name,
+            arg_descs, kwargs_keys, options_dict)
+        return [ObjectRef(ObjectID(b)) for b in refs_b]
+
+    def kill_actor(self, actor_id) -> None:
+        self._client.call("nested_kill_actor", actor_id.binary())
+
+    @property
+    def gcs(self):
+        client = self
+
+        class _NestedGcs:
+            def get_named_actor(self, name: str, namespace: str):
+                return client._client.call("nested_named_actor", name,
+                                           namespace)
+
+        return _NestedGcs()
+
     # -- unsupported surface ---------------------------------------------
 
     def _unsupported(self, what: str):
         raise NotImplementedError(
-            f"{what} from inside a task/actor is not supported yet; "
-            "create actors from the driver and pass handles if needed")
-
-    def create_actor(self, *a, **kw):
-        self._unsupported("creating actors")
-
-    def submit_actor_task(self, *a, **kw):
-        self._unsupported("calling actor methods")
-
-    def kill_actor(self, *a, **kw):
-        self._unsupported("killing actors")
+            f"{what} from inside a task/actor is not supported yet")
 
     def create_placement_group(self, *a, **kw):
         self._unsupported("creating placement groups")

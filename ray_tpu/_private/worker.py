@@ -433,6 +433,57 @@ class Worker:
         s.register("nested_get", self._nested_get)
         s.register("nested_put", self._nested_put)
         s.register("nested_wait", self._nested_wait)
+        s.register("nested_create_actor", self._nested_create_actor)
+        s.register("nested_actor_task", self._nested_actor_task)
+        s.register("nested_kill_actor", self._nested_kill_actor)
+        s.register("nested_named_actor", self._nested_named_actor)
+
+    def _deser_nested_args(self, arg_descs, kwargs_keys):
+        """Worker-shipped (value-blob | ref) descriptors -> live args."""
+        vals = []
+        for d in arg_descs:
+            if d[0] == "v":
+                v, _ = self.serde.deserialize_from_blob(memoryview(d[1]))
+                vals.append(v)
+            else:
+                vals.append(ObjectRef(ObjectID(d[1]), _count=False))
+        if kwargs_keys:
+            n = len(kwargs_keys)
+            return tuple(vals[:-n]), dict(zip(kwargs_keys, vals[-n:]))
+        return tuple(vals), {}
+
+    def _nested_create_actor(self, ctx, fid: bytes, fn_blob,
+                             class_name: str, arg_descs, kwargs_keys,
+                             options_dict) -> bytes:
+        if fn_blob is not None:
+            with self._functions_lock:
+                self._functions.setdefault(fid, fn_blob)
+        args, kwargs = self._deser_nested_args(arg_descs, kwargs_keys)
+        descriptor = FunctionDescriptor(function_id=fid, module="",
+                                        name=class_name)
+        actor_id = self.create_actor(descriptor, args, kwargs,
+                                     TaskOptions(**options_dict),
+                                     class_name)
+        return actor_id.binary()
+
+    def _nested_actor_task(self, ctx, actor_id_b: bytes, method: str,
+                           arg_descs, kwargs_keys, options_dict
+                           ) -> List[bytes]:
+        args, kwargs = self._deser_nested_args(arg_descs, kwargs_keys)
+        refs = self.submit_actor_task(
+            ActorID(actor_id_b), method, args, kwargs,
+            TaskOptions(**options_dict))
+        out = []
+        for ref in refs:
+            self.reference_counter.add_local_reference(ref.id())
+            out.append(ref.binary())
+        return out
+
+    def _nested_kill_actor(self, ctx, actor_id_b: bytes) -> None:
+        self.kill_actor(ActorID(actor_id_b))
+
+    def _nested_named_actor(self, ctx, name: str, namespace: str):
+        return self.gcs.get_named_actor(name, namespace)
 
     def _nested_submit(self, ctx, fid: bytes, fn_blob, fn_name: str,
                        arg_descs, kwargs_keys, options_dict) -> List[bytes]:

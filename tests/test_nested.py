@@ -72,21 +72,48 @@ def test_nested_wait(ray_start_regular):
     assert ray_tpu.get(parent.remote(), timeout=180) == (3, 0)
 
 
-def test_nested_actor_calls_raise_clearly(ray_start_regular):
-    @ray_tpu.remote
-    class A:
-        def f(self):
-            return 1
+def test_actor_created_and_called_from_task(ray_start_regular):
+    """Tasks can create actors and call their methods — the full core
+    API from anywhere."""
 
     @ray_tpu.remote
-    def tries_actor():
+    def orchestrate():
         import ray_tpu as rt
 
         @rt.remote
-        class B:
-            pass
+        class Acc:
+            def __init__(self, start):
+                self.v = start
 
-        B.remote()
+            def add(self, k):
+                self.v += k
+                return self.v
 
-    with pytest.raises(NotImplementedError, match="creating actors"):
-        ray_tpu.get(tries_actor.remote(), timeout=120)
+        acc = Acc.remote(100)
+        out = [rt.get(acc.add.remote(i)) for i in (1, 2, 3)]
+        rt.kill(acc)
+        return out
+
+    assert ray_tpu.get(orchestrate.remote(), timeout=180) == [101, 103, 106]
+
+
+def test_actor_handle_passed_into_task(ray_start_regular):
+    """A driver-created handle works inside a worker (method calls
+    route through the owner)."""
+
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.items = []
+
+        def push(self, x):
+            self.items.append(x)
+            return len(self.items)
+
+    @ray_tpu.remote
+    def producer(store, n):
+        import ray_tpu as rt
+        return [rt.get(store.push.remote(i)) for i in range(n)]
+
+    store = Store.remote()
+    assert ray_tpu.get(producer.remote(store, 3), timeout=180) == [1, 2, 3]
