@@ -202,6 +202,7 @@ class NodeManagerGroup:
         self._fail_task_cb = None  # (spec, exception) -> None; set by Worker
         self._recover_object_cb = None  # (ObjectID) -> bool; set by Worker
         self._ensure_host_copy_cb = None  # (ObjectID) -> (name, size)|None
+        self._stream_item_cb = None  # (TaskID, results); set by Worker
 
         self._lock = threading.RLock()
         self._raylets: Dict[NodeID, Raylet] = {}
@@ -476,6 +477,7 @@ class NodeManagerGroup:
             "name": spec.repr_name(),
             "runtime_env": spec.runtime_env,
             "owner_addr": self.object_server_addr,
+            "streaming": spec.streaming,
             "resources": dict(spec.resources),
         }
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
@@ -490,7 +492,19 @@ class NodeManagerGroup:
 
     def _on_remote_push(self, handle: RemoteNodeHandle, topic: str,
                         payload) -> None:
-        if topic == "task_done":
+        if topic == "task_stream":
+            results = []
+            for oid_b, kind, data, contained in payload.get("results", ()):
+                if kind == "remote":
+                    oid = ObjectID(oid_b)
+                    self.record_object_location(oid, handle.node_id)
+                    results.append((oid_b, "remote",
+                                    (handle.node_id, data), contained))
+                else:
+                    results.append((oid_b, kind, data, contained))
+            if self._stream_item_cb is not None:
+                self._stream_item_cb(TaskID(payload["task_id"]), results)
+        elif topic == "task_done":
             self._complete_remote_task(handle, payload)
         elif topic == "actor_ready":
             self._remote_actor_ready(handle, payload)
@@ -1029,6 +1043,7 @@ class NodeManagerGroup:
             "name": spec.repr_name(),
             "runtime_env": spec.runtime_env,
             "owner_addr": self.object_server_addr,
+            "streaming": spec.streaming,
         }
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             payload["actor_id"] = spec.actor_creation_id.binary()
@@ -1061,6 +1076,12 @@ class NodeManagerGroup:
 
     def _handle_reply(self, worker: BaseWorker, reply: tuple) -> None:
         op = reply[0]
+        if op == "stream":
+            # streaming generator item; the task keeps running
+            _, task_id_b, results = reply
+            if self._stream_item_cb is not None:
+                self._stream_item_cb(TaskID(task_id_b), results)
+            return
         if op == "done":
             _, task_id_b, results, err_blob = reply
             task_id = TaskID(task_id_b)

@@ -86,6 +86,50 @@ class ObjectRef:
         return (_deserialize_ref, (self._id.binary(),))
 
 
+class ObjectRefGenerator:
+    """Handle to a streaming generator task (``num_returns="streaming"``).
+
+    Reference: ``core_worker/generator_waiter.cc`` + streaming refs
+    [UNVERIFIED — mount empty, SURVEY.md §0]. Iterating yields an
+    ObjectRef per item AS the task produces them; the hidden completion
+    marker (return index 1) resolves to the item count — or raises the
+    task's error — when the generator finishes. Items occupy return
+    indices 2, 3, ...
+    """
+
+    def __init__(self, task_id: TaskID, done_ref: ObjectRef):
+        self._task_id = task_id
+        self._done_ref = done_ref
+        self._i = 0
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        from ray_tpu._private.worker import global_worker
+        w = global_worker()
+        done_oid = self._done_ref.id()
+        while True:
+            item_oid = ObjectID.from_index(self._task_id, self._i + 2)
+            if w.memory_store.contains(item_oid):
+                self._i += 1
+                return ObjectRef(item_oid)
+            if w.memory_store.contains(done_oid):
+                count = w.get([self._done_ref])[0]  # raises task errors
+                if self._i >= count:
+                    raise StopIteration
+                continue     # item landed between the two checks
+            w.memory_store.wait([item_oid, done_oid], 1, None)
+
+    def completed(self) -> ObjectRef:
+        """The completion marker (resolves to the item count)."""
+        return self._done_ref
+
+    def __repr__(self):
+        return (f"ObjectRefGenerator(task={self._task_id.hex()[:16]}, "
+                f"next_index={self._i})")
+
+
 def _deserialize_ref(binary: bytes) -> "ObjectRef":
     return ObjectRef(ObjectID(binary))
 

@@ -320,6 +320,24 @@ class RayletServer:
 
     def _handle_worker_reply(self, worker: BaseWorker, reply: tuple) -> None:
         op = reply[0]
+        if op == "stream":
+            # streaming generator item: seal big items locally, relay
+            # the (location) descriptors to the owner
+            _, task_id, results = reply
+            shipped = []
+            for oid_b, kind, data, contained in results:
+                if kind == "shm":
+                    name, size = data
+                    try:
+                        self.shm_store.adopt(ObjectID(oid_b), size)
+                    except FileNotFoundError:
+                        logger.warning("stream segment vanished: %s", name)
+                    shipped.append((oid_b, "remote", size, contained))
+                else:
+                    shipped.append((oid_b, kind, data, contained))
+            self._push_owner("task_stream", {"task_id": task_id,
+                                             "results": shipped})
+            return
         if op == "done":
             _, task_id, results, err_blob = reply
             with self._lock:

@@ -126,6 +126,9 @@ class TaskSpec:
     max_task_retries: int = 0
     name: str = ""
     runtime_env: Optional[dict] = None
+    # Streaming generator task: returns yield incrementally; return_ids
+    # holds only the completion marker (stores the item count).
+    streaming: bool = False
     # filled by the driver at submission:
     return_ids: List[ObjectID] = field(default_factory=list)
     depth: int = 0

@@ -185,6 +185,7 @@ class Worker:
         self.node_group._fail_task_cb = self._fail_task
         self.node_group._recover_object_cb = self._recover_object
         self.node_group._ensure_host_copy_cb = self._ensure_host_copy
+        self.node_group._stream_item_cb = self._on_stream_item
         self._pg_ready_refs: Dict[Any, ObjectID] = {}
         self.gcs.register_node(NodeInfo(
             node_id=self.node_group.head_node_id,
@@ -725,11 +726,16 @@ class Worker:
                     options: TaskOptions) -> List[ObjectRef]:
         cfg = get_config()
         task_id = self.next_task_id()
-        num_returns = options.num_returns
+        streaming = options.num_returns == "streaming"
+        num_returns = 1 if streaming else options.num_returns
         return_ids = [ObjectID.from_index(task_id, i + 1)
                       for i in range(num_returns)]
         max_retries = (options.max_retries if options.max_retries is not None
                        else cfg.task_max_retries)
+        if streaming:
+            # Re-running a generator would collide with already-stored
+            # item segments; streamed tasks don't retry (v1).
+            max_retries = 0
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -744,6 +750,7 @@ class Worker:
             scheduling_strategy=options.scheduling_strategy,
             name=options.name or fn_descriptor.repr_name(),
             runtime_env=_validate_runtime_env(options.runtime_env),
+            streaming=streaming,
             return_ids=return_ids,
         )
         self._apply_pg_strategy(spec, options)
@@ -752,6 +759,18 @@ class Worker:
         self.task_manager.add_pending_task(spec)
         self.node_group.submit_task(spec)
         return [ObjectRef(oid) for oid in return_ids]
+
+    def _on_stream_item(self, task_id: TaskID, results) -> None:
+        """An in-flight streaming generator yielded: materialize the
+        item into the owner's directory (streamed items are owned but
+        carry no lineage — a lost item is not reconstructable)."""
+        kind_map = {"inline": "blob", "shm": "shm", "remote": "remote"}
+        for oid_b, kind, data, contained in results:
+            oid = ObjectID(oid_b)
+            self.reference_counter.add_owned_object(oid)
+            entry = Entry(kind_map[kind], data,
+                          tuple(ObjectID(c) for c in contained))
+            self._store_result(oid, entry)
 
     def _apply_pg_strategy(self, spec: TaskSpec, options: TaskOptions
                            ) -> None:

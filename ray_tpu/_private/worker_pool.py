@@ -128,7 +128,8 @@ class InProcessWorker(BaseWorker):
             if op == "func":
                 self.env.cache_function(msg[1], msg[2])
             elif op in ("exec", "create_actor", "exec_actor"):
-                reply = self.env.execute(msg[1])
+                reply = self.env.execute(
+                    msg[1], emit=lambda r: self._reply(self, r))
                 self._reply(self, reply)
 
     def send(self, msg: tuple) -> None:

@@ -39,7 +39,7 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization
-from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.object_store import (
     ShmClient,
     _segment_name,
@@ -140,8 +140,10 @@ class ExecutionEnv:
 
     # -- task execution ----------------------------------------------------
 
-    def execute(self, payload: dict) -> tuple:
-        """Run one task payload; returns a ("done", ...) message."""
+    def execute(self, payload: dict, emit=None) -> tuple:
+        """Run one task payload; returns a ("done", ...) message.
+        ``emit`` ships incremental ("stream", ...) messages for
+        streaming generator tasks."""
         task_id = payload["task_id"]
         # Expose the owner channel + identity to nested API calls made
         # by the user function (see _private/nested_client.py).
@@ -168,6 +170,8 @@ class ExecutionEnv:
                     result = method(*args, **kwargs)
                 else:
                     result = fn(*args, **kwargs)
+                if payload.get("streaming"):
+                    return self._drain_generator(payload, result, emit)
             finally:
                 if payload["type"] != "create_actor":
                     restore_env()
@@ -191,6 +195,27 @@ class ExecutionEnv:
             if payload["type"] == "create_actor":
                 return ("actor_ready", payload["actor_id"], blob)
             return ("done", task_id, [], blob)
+
+    def _drain_generator(self, payload: dict, result, emit) -> tuple:
+        """Streaming task: store + emit each yielded item as it lands;
+        the final ("done", ...) carries the item count in the
+        completion-marker object (return index 1; items take 2..)."""
+        import inspect
+        task_id = payload["task_id"]
+        if not inspect.isgenerator(result):
+            raise TypeError(
+                "num_returns='streaming' requires the task to return a "
+                f"generator, got {type(result).__name__}")
+        tid = TaskID(task_id)
+        count = 0
+        for item in result:
+            count += 1
+            oid_b = ObjectID.from_index(tid, count + 1).binary()
+            stored = self.store_results([oid_b], (item,))
+            if emit is not None:
+                emit(("stream", task_id, stored))
+        done = self.store_results([payload["return_ids"][0]], (count,))
+        return ("done", task_id, done, None)
 
     def _get_callable(self, payload: dict) -> Callable:
         fid = payload["function_id"]
@@ -223,7 +248,7 @@ def worker_main(conn, session: str, max_inline_bytes: int,
             elif op == "func":
                 env.cache_function(msg[1], msg[2])
             elif op in ("exec", "create_actor", "exec_actor"):
-                reply = env.execute(msg[1])
+                reply = env.execute(msg[1], emit=conn.send)
                 conn.send(reply)
             elif op == "ping":
                 conn.send(("pong",))

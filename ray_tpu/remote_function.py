@@ -65,8 +65,17 @@ class RemoteFunction:
         from ray_tpu.util.scheduling_strategies import (
             apply_placement_group_option)
         apply_placement_group_option(opts)
-        refs = global_worker().submit_task(
-            self._get_descriptor(), args, kwargs, opts)
+        w = global_worker()
+        if opts.num_returns == "streaming":
+            if not hasattr(w, "memory_store"):
+                raise NotImplementedError(
+                    "streaming generators inside tasks are not "
+                    "supported yet")
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+            refs = w.submit_task(self._get_descriptor(), args, kwargs,
+                                 opts)
+            return ObjectRefGenerator(refs[0].id().task_id(), refs[0])
+        refs = w.submit_task(self._get_descriptor(), args, kwargs, opts)
         if opts.num_returns == 1:
             return refs[0]
         return refs
