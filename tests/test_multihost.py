@@ -1,0 +1,26 @@
+"""Multi-host mesh tests: the same SPMD program over devices spanning
+processes, collectives crossing the process boundary (DCN-plane shape;
+SURVEY.md §5 distributed-comm row)."""
+
+import os
+
+import pytest
+
+from ray_tpu.parallel.multihost import spawn_local_group
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_train_step_over_two_simulated_hosts():
+    results = spawn_local_group(
+        os.path.join(HERE, "multihost_member.py"),
+        num_processes=2, devices_per_process=4, timeout=600)
+    for r in results:
+        assert r.returncode == 0, r.stdout[-3000:]
+        assert "MEMBER-OK" in r.stdout
+        assert "global=8" in r.stdout
+    # every host computed the same replicated loss
+    losses = {line.split("losses=")[1]
+              for r in results for line in r.stdout.splitlines()
+              if "MEMBER-OK" in line}
+    assert len(losses) == 1, losses
