@@ -37,6 +37,8 @@ class TrainContext:
     report_dir: str = ""
     config: Dict[str, Any] = field(default_factory=dict)
     collective_group: str = ""
+    # per-attempt backend wiring (e.g. the torch c10d rendezvous)
+    backend_config: Dict[str, Any] = field(default_factory=dict)
     datasets: Dict[str, List] = field(default_factory=dict)  # name->blocks
     latest_checkpoint: Optional[Checkpoint] = None
     # When True (Tune trials), report() blocks until the controller acks

@@ -92,16 +92,26 @@ class _TrainWorker:
                                   timeout_s=120.0)
         return rank
 
-    def run(self, loop_blob: bytes, ctx_fields: dict, blocks_by_name):
+    def run(self, loop_blob: bytes, ctx_fields: dict, blocks_by_name,
+            setup_blob=None):
         import cloudpickle
         ctx = TrainContext(**ctx_fields)
         ctx.datasets = blocks_by_name
         init_session(ctx)
+        teardown = None
         try:
+            if setup_blob is not None:
+                setup = cloudpickle.loads(setup_blob)
+                teardown = setup(ctx)
             loop = cloudpickle.loads(loop_blob)
             loop(ctx.config) if _wants_arg(loop) else loop()
             return True
         finally:
+            if teardown is not None:
+                try:
+                    teardown()
+                except Exception:
+                    pass
             shutdown_session()
 
 
@@ -126,6 +136,13 @@ class DataParallelTrainer:
         self._run_config = run_config or RunConfig()
         self._datasets = datasets or {}
         self._resume_ckpt = resume_from_checkpoint
+        # subclass backend hook: runs in each worker before the loop
+        # (returns an optional teardown callable)
+        self._backend_setup: Optional[Callable] = None
+
+    def _attempt_backend_config(self) -> Dict[str, Any]:
+        """Per-attempt wiring shipped to every worker (ports etc.)."""
+        return {}
 
     # -- experiment dirs ---------------------------------------------------
 
@@ -202,6 +219,9 @@ class DataParallelTrainer:
             shards = self._shard_datasets(n)
             import cloudpickle
             blob = cloudpickle.dumps(self._loop)
+            setup_blob = (cloudpickle.dumps(self._backend_setup)
+                          if self._backend_setup is not None else None)
+            backend_config = self._attempt_backend_config()
             refs = []
             for i, w in enumerate(workers):
                 ctx_fields = dict(
@@ -210,8 +230,10 @@ class DataParallelTrainer:
                     trial_dir=trial_dir, report_dir=report_dir,
                     config=dict(self._loop_config),
                     collective_group=group_name,
+                    backend_config=dict(backend_config),
                     latest_checkpoint=latest_ckpt)
-                refs.append(w.run.remote(blob, ctx_fields, shards[i]))
+                refs.append(w.run.remote(blob, ctx_fields, shards[i],
+                                         setup_blob))
 
             while True:
                 ready, not_ready = ray_tpu.wait(

@@ -1,0 +1,62 @@
+"""TorchTrainer: c10d gloo process group over the actor gang + DDP.
+
+Reference analog: ``python/ray/train/tests/test_torch_trainer.py``
+[UNVERIFIED — mount empty, SURVEY.md §0].
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import ScalingConfig
+from ray_tpu.train.torch import TorchTrainer
+
+
+def test_torch_ddp_gang_trains_and_syncs(ray_start_regular):
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+        from ray_tpu import train
+        from ray_tpu.train import torch as train_torch
+
+        ctx = train.get_context()
+        assert dist.is_initialized()
+        assert dist.get_world_size() == 2
+        assert dist.get_rank() == ctx.get_rank()
+
+        torch.manual_seed(1234)          # same init on every rank
+        model = torch.nn.Linear(4, 1)
+        model = train_torch.prepare_model(model)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+
+        # rank-dependent data: DDP's gradient allreduce is the only
+        # thing keeping replicas identical
+        rng = np.random.RandomState(100 + ctx.get_rank())
+        x = torch.tensor(rng.rand(64, 4), dtype=torch.float32)
+        w_true = torch.tensor([[1.0], [-2.0], [3.0], [0.5]])
+        y = x @ w_true
+
+        losses = []
+        for _ in range(30):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        param_sum = float(sum(p.detach().sum() for p in
+                              model.parameters()))
+        gathered = [torch.zeros(1) for _ in range(2)]
+        dist.all_gather(gathered, torch.tensor([param_sum]))
+        train.report({"loss": losses[-1], "first_loss": losses[0],
+                      "param_sum_r0": float(gathered[0]),
+                      "param_sum_r1": float(gathered[1]),
+                      "rank": ctx.get_rank()})
+
+    result = TorchTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2)).fit()
+    assert result.error is None, result.error
+    m = result.metrics
+    assert m["loss"] < m["first_loss"] * 0.5          # learned
+    # allreduced gradients keep both replicas bit-identical
+    assert m["param_sum_r0"] == pytest.approx(m["param_sum_r1"],
+                                              abs=1e-6)
