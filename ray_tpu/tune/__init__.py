@@ -15,6 +15,7 @@ from ray_tpu.tune.schedulers import (
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     Searcher,
+    TPESearch,
     choice,
     grid_search,
     loguniform,
@@ -28,8 +29,8 @@ from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
 __all__ = [
     "ASHAScheduler", "BasicVariantGenerator", "Checkpoint",
     "FIFOScheduler", "HyperBandScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "ResultGrid", "Searcher", "Trial",
-    "TrialScheduler", "TuneConfig", "Tuner", "choice", "get_checkpoint",
-    "grid_search", "loguniform", "quniform", "randint", "report",
-    "sample_from", "uniform",
+    "PopulationBasedTraining", "ResultGrid", "Searcher", "TPESearch",
+    "Trial", "TrialScheduler", "TuneConfig", "Tuner", "choice",
+    "get_checkpoint", "grid_search", "loguniform", "quniform", "randint",
+    "report", "sample_from", "uniform",
 ]

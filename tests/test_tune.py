@@ -14,6 +14,51 @@ def _runtime(ray_start_regular):
     yield
 
 
+def test_tpe_search_concentrates_on_optimum():
+    """Model-based search: after warmup, TPE suggestions cluster near
+    the best region (continuous + categorical + log dims)."""
+    from ray_tpu.tune import TPESearch
+
+    space = {"x": tune.uniform(-5, 5), "kind": tune.choice(["a", "b"]),
+             "lr": tune.loguniform(1e-4, 1e0)}
+    searcher = TPESearch(space, metric="loss", mode="min",
+                         num_samples=60, n_initial_points=10, seed=3)
+    suggested = []
+    for i in range(60):
+        cfg = searcher.suggest(f"t{i}")
+        assert cfg is not None
+        loss = ((cfg["x"] - 2.0) ** 2
+                + (0.0 if cfg["kind"] == "a" else 5.0)
+                + abs(np.log10(cfg["lr"]) + 2.0))   # optimum lr=1e-2
+        searcher.on_trial_complete(f"t{i}", {"loss": loss})
+        suggested.append(cfg)
+    assert searcher.suggest("t-done") is None       # budget exhausted
+    early = suggested[:10]
+    late = suggested[-20:]
+    err_early = np.mean([abs(c["x"] - 2.0) for c in early])
+    err_late = np.mean([abs(c["x"] - 2.0) for c in late])
+    assert err_late < err_early, (err_early, err_late)
+    assert sum(1 for c in late if c["kind"] == "a") >= 14
+
+
+def test_tpe_with_tuner():
+    from ray_tpu.tune import TPESearch, TuneConfig, Tuner
+
+    def trainable(config):
+        tune.report({"score": (config["x"] - 1.0) ** 2})
+
+    space = {"x": tune.uniform(-3, 3)}
+    grid = Tuner(
+        trainable, param_space=space,
+        tune_config=TuneConfig(
+            metric="score", mode="min", max_concurrent_trials=3,
+            search_alg=TPESearch(space, metric="score", mode="min",
+                                 num_samples=12, n_initial_points=4,
+                                 seed=0))).fit()
+    assert len(grid) == 12
+    assert grid.get_best_result().metrics["score"] < 1.0
+
+
 def test_grid_and_random_search():
     def trainable(config):
         tune.report({"score": config["a"] * 10 + config["b"]})
