@@ -156,3 +156,26 @@ class GcsLite:
     def kv_keys(self, prefix: bytes, namespace: str = "") -> List[bytes]:
         with self._lock:
             return [k for k in self._kv[namespace] if k.startswith(prefix)]
+
+    # -- persistence (reference: Redis-backed GcsTableStorage) -------------
+
+    def dump_state(self) -> bytes:
+        import pickle
+        with self._lock:
+            return pickle.dumps({
+                "nodes": self._nodes,
+                "actors": self._actors,
+                "named_actors": self._named_actors,
+                "kv": dict(self._kv),
+                "job_counter": self._job_counter,
+            })
+
+    def load_state(self, blob: bytes) -> None:
+        import pickle
+        state = pickle.loads(blob)
+        with self._lock:
+            self._nodes = state["nodes"]
+            self._actors = state["actors"]
+            self._named_actors = state["named_actors"]
+            self._kv = defaultdict(dict, state["kv"])
+            self._job_counter = state["job_counter"]

@@ -32,10 +32,27 @@ logger = logging.getLogger(__name__)
 
 
 class GcsServer:
-    """RPC surface + health manager around GcsLite."""
+    """RPC surface + health manager around GcsLite.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    ``persist_path`` makes the tables restart-tolerant (the role of the
+    reference's Redis-backed GcsTableStorage): state snapshots to the
+    file after every mutation batch and reloads on start, so a
+    restarted GCS comes back knowing its nodes, actors, and KV.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
         self.state = GcsLite()
+        self._persist_path = persist_path
+        if persist_path and os.path.exists(persist_path):
+            try:
+                with open(persist_path, "rb") as f:
+                    self.state.load_state(f.read())
+                logger.info("gcs state restored from %s", persist_path)
+            except Exception:
+                logger.exception("gcs state restore failed; starting "
+                                 "fresh")
+        self._dirty = threading.Event()
         self._subs_lock = threading.Lock()
         # channel -> list of subscriber connections
         self._subscribers: Dict[str, List[ConnectionContext]] = {}
@@ -81,6 +98,43 @@ class GcsServer:
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="rtpu-gcs-health")
         self._health_thread.start()
+        if self._persist_path:
+            # mark-dirty on every mutating handler; a writer thread
+            # coalesces snapshots
+            for method in ("register_node", "remove_node",
+                           "register_actor", "update_actor_state",
+                           "kv_put", "kv_del", "next_job_id"):
+                self._wrap_dirty(method)
+            self._persist_thread = threading.Thread(
+                target=self._persist_loop, daemon=True,
+                name="rtpu-gcs-persist")
+            self._persist_thread.start()
+
+    def _wrap_dirty(self, method: str) -> None:
+        fn = self._handlers_get(method)
+
+        def wrapped(ctx, *args, _fn=fn):
+            out = _fn(ctx, *args)
+            self._dirty.set()
+            return out
+
+        self.server.register(method, wrapped)
+
+    def _handlers_get(self, method: str):
+        return self.server._handlers[method]
+
+    def _persist_loop(self) -> None:
+        while not self._shutdown.wait(0.2):
+            if not self._dirty.is_set():
+                continue
+            self._dirty.clear()
+            try:
+                tmp = self._persist_path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(self.state.dump_state())
+                os.replace(tmp, self._persist_path)
+            except Exception:
+                logger.exception("gcs persistence write failed")
 
     # -- handlers ------------------------------------------------------
 
@@ -184,10 +238,12 @@ def main(argv=None) -> None:
                    help="file to write the bound address to")
     p.add_argument("--config", default="",
                    help="serialized system config json")
+    p.add_argument("--persist-path", default="",
+                   help="snapshot state to this file; reload on start")
     args = p.parse_args(argv)
     if args.config:
         get_config().load_serialized(args.config)
-    server = GcsServer()
+    server = GcsServer(persist_path=args.persist_path or None)
     tmp = args.port_file + ".tmp"
     with open(tmp, "w") as f:
         f.write(f"{server.address[0]}:{server.address[1]}")
@@ -201,7 +257,8 @@ def main(argv=None) -> None:
         server.shutdown()
 
 
-def spawn_gcs_process(session: str, config_json: str = ""
+def spawn_gcs_process(session: str, config_json: str = "",
+                      persist: bool = False
                       ) -> Tuple["subprocess.Popen", Tuple[str, int]]:
     """Start a GCS server as a detached process; returns (proc, addr)."""
     import subprocess
@@ -217,10 +274,12 @@ def spawn_gcs_process(session: str, config_json: str = ""
         + env.get("PYTHONPATH", "").split(os.pathsep))
     env["JAX_PLATFORMS"] = "cpu"   # the GCS never touches the TPU
     log = open(os.path.join(d, "gcs.log"), "ab")
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu._private.gcs_server",
-         "--port-file", port_file, "--config", config_json],
-        env=env, start_new_session=True, stdout=log, stderr=log)
+    cmd = [sys.executable, "-m", "ray_tpu._private.gcs_server",
+           "--port-file", port_file, "--config", config_json]
+    if persist:
+        cmd += ["--persist-path", os.path.join(d, "gcs_state.bin")]
+    proc = subprocess.Popen(cmd, env=env, start_new_session=True,
+                            stdout=log, stderr=log)
     log.close()
     deadline = time.monotonic() + 20.0
     while time.monotonic() < deadline:
