@@ -33,7 +33,8 @@ from ray_tpu.exceptions import GetTimeoutError, TaskError
 
 _SHIPPED_OPTION_FIELDS = (
     "num_cpus", "num_tpus", "num_gpus", "memory", "resources",
-    "num_returns", "max_retries", "name")
+    "num_returns", "max_retries", "name", "scheduling_strategy",
+    "placement_group", "placement_group_bundle_index")
 _SHIPPED_ACTOR_FIELDS = _SHIPPED_OPTION_FIELDS + (
     "max_restarts", "max_task_retries", "namespace", "get_if_exists",
     "lifetime")
@@ -59,6 +60,8 @@ class NestedClient:
         self.serde = serialization.get_context()
         self.reference_counter = _NoopRefCounter()
         self.session = f"nested-{owner_addr[1]}"
+        from ray_tpu._private.ids import JobID
+        self.job_id = JobID.from_int(1)    # pg-id minting (random suffix)
         self._fn_lock = threading.Lock()
         self._shipped_fids: set = set()
         self._fn_blobs: Dict[bytes, bytes] = {}
@@ -184,14 +187,38 @@ class NestedClient:
 
         return _NestedGcs()
 
-    # -- unsupported surface ---------------------------------------------
+    # -- placement groups ------------------------------------------------
 
-    def _unsupported(self, what: str):
-        raise NotImplementedError(
-            f"{what} from inside a task/actor is not supported yet")
+    def create_placement_group(self, pg_id, bundles, strategy, name):
+        self._client.call("nested_create_pg", pg_id.binary(),
+                          [dict(b) for b in bundles], strategy, name)
 
-    def create_placement_group(self, *a, **kw):
-        self._unsupported("creating placement groups")
+    def remove_placement_group(self, pg_id) -> None:
+        self._client.call("nested_remove_pg", pg_id.binary())
+
+    def pg_ready_ref(self, pg_id) -> ObjectRef:
+        return ObjectRef(ObjectID(
+            self._client.call("nested_pg_ready", pg_id.binary())))
+
+    @property
+    def pg_manager(self):
+        client = self
+
+        class _Info:
+            def __init__(self, state, bundles):
+                self.state = state
+                self.bundles = bundles
+
+        class _Shim:
+            def get(self, pg_id):
+                out = client._client.call("nested_pg_info",
+                                          pg_id.binary())
+                return None if out is None else _Info(*out)
+
+            def table(self):
+                return client._client.call("nested_pg_table")
+
+        return _Shim()
 
     def cluster_resources(self) -> dict:
         return {}

@@ -28,6 +28,7 @@ from ray_tpu._private.ids import (
     JobID,
     NodeID,
     ObjectID,
+    PlacementGroupID,
     TaskID,
 )
 from ray_tpu._private.node_manager import NodeManagerGroup
@@ -438,6 +439,29 @@ class Worker:
         s.register("nested_actor_task", self._nested_actor_task)
         s.register("nested_kill_actor", self._nested_kill_actor)
         s.register("nested_named_actor", self._nested_named_actor)
+        s.register("nested_create_pg",
+                   lambda ctx, b, bundles, strat, name:
+                   self.create_placement_group(
+                       PlacementGroupID(b), bundles, strat, name)
+                   and None)
+        s.register("nested_remove_pg",
+                   lambda ctx, b: self.remove_placement_group(
+                       PlacementGroupID(b)))
+        s.register("nested_pg_ready", self._nested_pg_ready)
+        s.register("nested_pg_info", self._nested_pg_info)
+        s.register("nested_pg_table",
+                   lambda ctx: self.pg_manager.table())
+
+    def _nested_pg_ready(self, ctx, pg_id_b: bytes) -> bytes:
+        ref = self.pg_ready_ref(PlacementGroupID(pg_id_b))
+        self.reference_counter.add_local_reference(ref.id())
+        return ref.binary()
+
+    def _nested_pg_info(self, ctx, pg_id_b: bytes):
+        info = self.pg_manager.get(PlacementGroupID(pg_id_b))
+        if info is None:
+            return None
+        return (info.state, [dict(b) for b in info.bundles])
 
     def _deser_nested_args(self, arg_descs, kwargs_keys):
         """Worker-shipped (value-blob | ref) descriptors -> live args."""

@@ -17,9 +17,27 @@ from typing import Dict, List, Optional
 from ray_tpu._private.object_ref import ObjectRef
 
 
+def _rebuild_replica_set(name: str, replicas: List) -> "ReplicaSet":
+    rs = ReplicaSet(name)
+    rs.set_replicas(replicas)
+    return rs
+
+
 class ReplicaSet:
     """The router's view of one deployment's replicas + in-flight
-    accounting. Thread-safe; shared by handles and the controller."""
+    accounting. Thread-safe; shared by handles and the controller.
+
+    Picklable (model composition: a DeploymentHandle shipped into
+    another deployment's replica): the receiving process gets the
+    replica list with fresh local in-flight counts — pow-2 then
+    balances on that process's own traffic, the same client-side
+    signal the reference's handles use. The copy's membership is a
+    snapshot; replaced replicas surface as actor-dead errors on call.
+    """
+
+    def __reduce__(self):
+        return (_rebuild_replica_set,
+                (self.deployment_name, self.replicas()))
 
     def __init__(self, deployment_name: str):
         self.deployment_name = deployment_name

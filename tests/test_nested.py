@@ -97,6 +97,36 @@ def test_actor_created_and_called_from_task(ray_start_regular):
     assert ray_tpu.get(orchestrate.remote(), timeout=180) == [101, 103, 106]
 
 
+def test_placement_group_from_task(ray_start_regular):
+    """Gang scheduling works from inside a task (the full PG surface
+    over the nested channel)."""
+
+    @ray_tpu.remote
+    def gang():
+        import ray_tpu as rt
+        from ray_tpu.util.placement_group import (
+            placement_group, remove_placement_group)
+        from ray_tpu.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy)
+
+        pg = placement_group([{"CPU": 1}] * 2, strategy="PACK")
+        rt.get(pg.ready(), timeout=60)
+
+        @rt.remote(num_cpus=1)
+        def member(i):
+            return i * 7
+
+        refs = [member.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                pg, placement_group_bundle_index=i)).remote(i)
+            for i in range(2)]
+        out = rt.get(refs)
+        remove_placement_group(pg)
+        return out
+
+    assert ray_tpu.get(gang.remote(), timeout=240) == [0, 7]
+
+
 def test_actor_handle_passed_into_task(ray_start_regular):
     """A driver-created handle works inside a worker (method calls
     route through the owner)."""

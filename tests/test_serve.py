@@ -149,6 +149,29 @@ def test_http_ingress(serve_instance):
     assert st["Echo"]["state"] == "HEALTHY"
 
 
+def test_model_composition_via_handles(serve_instance):
+    """A deployment holding another's DeploymentHandle calls through
+    it from inside its replica (reference: handle-based composition)."""
+
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            import ray_tpu as rt
+            return rt.get(self.pre.remote(x)) + 1
+
+    pre = serve.run(Preprocess.bind())
+    handle = serve.run(Pipeline.bind(pre), name="Pipeline")
+    assert ray_tpu.get(handle.remote(5), timeout=120) == 11
+
+
 def test_jitted_model_replica(serve_instance):
     """The flagship serving shape: a replica jit-compiles a transformer
     forward at construction and serves the compiled program."""
