@@ -12,12 +12,20 @@ from ray_tpu.models.transformer import (  # noqa: F401
     loss_fn,
     param_specs,
 )
+from ray_tpu.models.vit import (  # noqa: F401
+    ViTConfig,
+    init_vit_params,
+    vit_forward,
+    vit_loss_fn,
+    vit_param_specs,
+)
 
 _TRAINING = ("TrainState", "init_state", "make_optimizer",
              "make_train_step", "state_specs")
 
-__all__ = ["TransformerConfig", "forward", "init_params", "loss_fn",
-           "param_specs", *_TRAINING]
+__all__ = ["TransformerConfig", "ViTConfig", "forward", "init_params",
+           "init_vit_params", "loss_fn", "param_specs", "vit_forward",
+           "vit_loss_fn", "vit_param_specs", *_TRAINING]
 
 
 def __getattr__(name):

@@ -1,0 +1,126 @@
+"""Vision Transformer on the shared block stack.
+
+Second model family (the flagship LM is ``transformer.py``). The
+reference frameworks host vision models through torch; here ViT
+reuses the same jitted block stack as the LM — patch embedding in,
+non-causal attention inside, mean-pool + linear head out — so every
+parallelism axis (tp on heads/ff, fsdp on d_model, sp over the patch
+sequence) and the Pallas attention kernels apply unchanged. Position
+information is 1D RoPE over patch index (RoPE-ViT style) rather than
+learned embeddings: it rides the existing block code and extrapolates
+across resolutions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    _attention,
+    _block_forward,
+    _dense_init,
+    init_params,
+    param_specs,
+    rms_norm,
+)
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    channels: int = 3
+    num_classes: int = 10
+    d_model: int = 128
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8
+    d_ff: int = 352
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+    def block_config(self) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=8, d_model=self.d_model, n_layers=self.n_layers,
+            n_heads=self.n_heads, n_kv_heads=self.n_kv_heads,
+            d_ff=self.d_ff, max_seq_len=self.num_patches,
+            dtype=self.dtype, remat=self.remat)
+
+
+def init_vit_params(key: jax.Array, cfg: ViTConfig) -> Dict:
+    k_inner, k_patch, k_head = jax.random.split(key, 3)
+    inner = init_params(k_inner, cfg.block_config())
+    return {
+        "patch_embed": _dense_init(k_patch,
+                                   (cfg.patch_dim, cfg.d_model)),
+        "patch_bias": jnp.zeros((cfg.d_model,), jnp.float32),
+        "blocks": inner["blocks"],
+        "final_norm": inner["final_norm"],
+        "head": _dense_init(k_head, (cfg.d_model, cfg.num_classes)),
+    }
+
+
+def vit_param_specs(cfg: ViTConfig) -> Dict:
+    inner = param_specs(cfg.block_config())
+    return {
+        "patch_embed": P(None, "tp"),
+        "patch_bias": P(None),
+        "blocks": inner["blocks"],
+        "final_norm": P(None),
+        "head": P("tp", None),
+    }
+
+
+def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """[B, H, W, C] -> [B, N, P*P*C] (row-major patch grid)."""
+    b, h, w, c = images.shape
+    p = cfg.patch_size
+    x = images.reshape(b, h // p, p, w // p, p, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // p) * (w // p), p * p * c)
+
+
+def vit_forward(params: Dict, images: jax.Array,
+                cfg: ViTConfig) -> jax.Array:
+    """images [B, H, W, C] float -> logits [B, num_classes]."""
+    inner = cfg.block_config()
+    x = patchify(images.astype(cfg.dtype), cfg)
+    x = x @ params["patch_embed"].astype(cfg.dtype) \
+        + params["patch_bias"].astype(cfg.dtype)
+    positions = jnp.broadcast_to(
+        jnp.arange(x.shape[1], dtype=jnp.int32)[None, :],
+        (x.shape[0], x.shape[1]))
+    attn = functools.partial(_attention, causal=False)
+    blk = functools.partial(_block_forward, cfg=inner, attn_fn=attn)
+    if cfg.remat:
+        blk = jax.checkpoint(blk)
+    for block in params["blocks"]:
+        x = blk(block, x, positions)
+    x = rms_norm(x, params["final_norm"])
+    pooled = jnp.mean(x, axis=1)
+    return (pooled @ params["head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def vit_loss_fn(params: Dict, batch: Dict[str, jax.Array],
+                cfg: ViTConfig) -> jax.Array:
+    logits = vit_forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(
+        logp, batch["labels"][:, None], axis=-1))
