@@ -138,6 +138,30 @@ def _cmd_job(args) -> int:
         client.close()
 
 
+def _cmd_logs(args) -> int:
+    """List or tail session daemon logs (GCS, raylets, jobs)."""
+    import glob
+    paths = sorted(glob.glob("/tmp/rtpu_*/*.log")
+                   + glob.glob("/tmp/rtpu_jobs/*.log"))
+    if args.session:
+        paths = [p for p in paths if args.session in p]
+    if not paths:
+        print("no logs found")
+        return 0
+    if args.list:
+        for p in paths:
+            print(f"{os.path.getsize(p):>10}  {p}")
+        return 0
+    for p in paths:
+        print(f"==> {p} <==")
+        with open(p, "r", errors="replace") as f:
+            lines = f.readlines()
+        for line in lines[-args.tail:]:
+            print(line, end="")
+        print()
+    return 0
+
+
 def _cmd_workflows(args) -> int:
     from ray_tpu import workflow
     rows = workflow.list_all(args.storage)
@@ -176,6 +200,12 @@ def main(argv=None) -> int:
     sp = sub.add_parser("workflows", help="list workflows")
     sp.add_argument("--storage", default=None)
     sp.set_defaults(fn=_cmd_workflows)
+
+    sp = sub.add_parser("logs", help="list/tail session daemon logs")
+    sp.add_argument("--session", default="")
+    sp.add_argument("--list", action="store_true")
+    sp.add_argument("--tail", type=int, default=50)
+    sp.set_defaults(fn=_cmd_logs)
 
     sp = sub.add_parser("job", help="submit/track jobs")
     jsub = sp.add_subparsers(dest="job_command", required=True)

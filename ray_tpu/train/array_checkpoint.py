@@ -1,0 +1,77 @@
+"""Sharded-array checkpointing (Orbax-backed).
+
+Reference: checkpointing is library-layer in the reference (Train's
+``Checkpoint`` directories via pyarrow.fs), and torch state dicts
+gather to one host before writing. TPU-native checkpointing must not:
+a sharded ``jax.Array`` saves with EVERY host writing its own shards
+in parallel and restores directly into a target sharding — the
+Orbax-style async multi-host flow SURVEY.md §5 prescribes. This module
+is the thin seam over orbax so Train/Tune checkpoints can carry
+device-sharded state without host gathers; the async path keeps the
+save off the training step's critical path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+__all__ = ["save_sharded", "restore_sharded", "AsyncSave"]
+
+
+def _checkpointer(use_async: bool):
+    import orbax.checkpoint as ocp
+    if use_async:
+        return ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return ocp.StandardCheckpointer()
+
+
+class AsyncSave:
+    """Handle for an in-flight async save; ``wait()`` to finalize."""
+
+    def __init__(self, checkpointer):
+        self._ckptr = checkpointer
+
+    def wait(self) -> None:
+        self._ckptr.wait_until_finished()
+        self._ckptr.close()
+
+
+def save_sharded(path: str, pytree: Any, *,
+                 async_save: bool = False) -> Optional[AsyncSave]:
+    """Write a pytree of (possibly sharded) jax arrays. Each process
+    writes only its own shards. With ``async_save`` the call returns
+    immediately and the device arrays are snapshotted — training may
+    donate/overwrite them while bytes stream out."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = _checkpointer(async_save)
+    ckptr.save(path, pytree, force=True)
+    if async_save:
+        return AsyncSave(ckptr)
+    ckptr.close()
+    return None
+
+
+def restore_sharded(path: str, template: Any) -> Any:
+    """Restore into the shapes/dtypes/shardings of ``template`` —
+    a pytree of arrays or of ``jax.ShapeDtypeStruct``s carrying
+    ``sharding``. Shards load directly to their devices; no host
+    gather."""
+    import jax
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+
+    def as_abstract(x):
+        if hasattr(x, "sharding"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=x.sharding)
+        return x
+
+    abstract = jax.tree.map(as_abstract, template)
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        return ckptr.restore(path, abstract)
+    finally:
+        ckptr.close()

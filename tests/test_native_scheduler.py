@@ -120,3 +120,21 @@ def test_native_class_fill_entry_point():
     # no node oversubscribed
     used = (takes[:, :, None] * demands[:, None, :]).sum(axis=0)
     assert (used <= total + 1e-5).all()
+
+
+def test_native_scheduler_clean_under_sanitizers():
+    """ASAN+UBSAN build + smoke of the native policy (the reference's
+    sanitizer CI configs; SURVEY.md §5)."""
+    import os
+    import shutil
+    import subprocess
+
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("native toolchain unavailable")
+    native_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "native")
+    proc = subprocess.run(["make", "-C", native_dir, "asan"],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "SMOKE-OK" in proc.stdout
