@@ -36,8 +36,8 @@ _SHIPPED_OPTION_FIELDS = (
     "num_returns", "max_retries", "name", "scheduling_strategy",
     "placement_group", "placement_group_bundle_index")
 _SHIPPED_ACTOR_FIELDS = _SHIPPED_OPTION_FIELDS + (
-    "max_restarts", "max_task_retries", "namespace", "get_if_exists",
-    "lifetime")
+    "max_restarts", "max_task_retries", "max_concurrency", "namespace",
+    "get_if_exists", "lifetime")
 
 
 class _NoopRefCounter:
@@ -111,11 +111,18 @@ class NestedClient:
 
     # -- object plane ----------------------------------------------------
 
+    @staticmethod
+    def _current_task_id() -> bytes:
+        # Read per-call, per-thread: concurrent actor calls each bind
+        # their own identity (resource release on blocking get).
+        from ray_tpu._private.worker_process import _CURRENT_TASK
+        return _CURRENT_TASK.get("task_id", b"")
+
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
         rpc_timeout = None if timeout is None else timeout + 30.0
         status, items = self._client.call(
-            "nested_get", self._task_id,
+            "nested_get", self._current_task_id(),
             [r.id().binary() for r in refs], timeout,
             timeout=rpc_timeout)
         if status == "timeout":

@@ -482,6 +482,7 @@ class NodeManagerGroup:
         }
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             payload["actor_id"] = spec.actor_creation_id.binary()
+            payload["max_concurrency"] = spec.max_concurrency
         fid = spec.function.function_id
         if fid not in handle.known_functions:
             payload["function_blob"] = self._function_blob(fid)
@@ -1047,6 +1048,7 @@ class NodeManagerGroup:
         }
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             payload["actor_id"] = spec.actor_creation_id.binary()
+            payload["max_concurrency"] = spec.max_concurrency
         try:
             raylet.worker_pool.ensure_function(
                 worker, spec.function.function_id,

@@ -124,6 +124,7 @@ class TaskSpec:
     sequence_number: int = 0
     max_restarts: int = 0
     max_task_retries: int = 0
+    max_concurrency: int = 1
     name: str = ""
     runtime_env: Optional[dict] = None
     # Streaming generator task: returns yield incrementally; return_ids

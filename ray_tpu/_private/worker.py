@@ -938,6 +938,7 @@ class Worker:
             actor_creation_id=actor_id,
             max_restarts=options.max_restarts,
             max_task_retries=options.max_task_retries,
+            max_concurrency=max(1, options.max_concurrency),
             scheduling_strategy=options.scheduling_strategy,
             name=options.name or class_name,
             runtime_env=_validate_runtime_env(options.runtime_env),

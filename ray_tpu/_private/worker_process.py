@@ -47,9 +47,20 @@ from ray_tpu._private.object_store import (
 )
 from ray_tpu.exceptions import TaskError
 
-# Per-process pointer at the currently-executing task's owner channel
-# (process workers run one task at a time).
-_CURRENT_TASK: Dict[str, Any] = {"owner_addr": None, "task_id": b""}
+class _TaskLocal(threading.local):
+    """Per-THREAD pointer at the currently-executing task's owner
+    channel — thread-local because max_concurrency>1 actors execute
+    calls on a pool, and nested API calls must bind to their own
+    task's identity."""
+
+    owner_addr = None
+    task_id = b""
+
+    def get(self, key, default=None):
+        return getattr(self, key, default)
+
+
+_CURRENT_TASK = _TaskLocal()
 
 
 class ExecutionEnv:
@@ -61,6 +72,7 @@ class ExecutionEnv:
         self.functions: Dict[bytes, Callable] = {}
         self.actors: Dict[bytes, Any] = {}
         self._actor_envs: Dict[bytes, Optional[dict]] = {}
+        self._actor_conc: Dict[bytes, int] = {}
         self.shm_client = ShmClient(session)
         self.serde = serialization.get_context()
         self.current_task_name = ""
@@ -147,8 +159,8 @@ class ExecutionEnv:
         task_id = payload["task_id"]
         # Expose the owner channel + identity to nested API calls made
         # by the user function (see _private/nested_client.py).
-        _CURRENT_TASK["owner_addr"] = payload.get("owner_addr")
-        _CURRENT_TASK["task_id"] = task_id
+        _CURRENT_TASK.owner_addr = payload.get("owner_addr")
+        _CURRENT_TASK.task_id = task_id
         try:
             fn = self._get_callable(payload)
             args, kwargs = self.resolve_args(payload["args"],
@@ -163,6 +175,8 @@ class ExecutionEnv:
                     # actors keep their runtime_env for their lifetime
                     self._actor_envs[payload["actor_id"]] = \
                         payload.get("runtime_env")
+                    self._actor_conc[payload["actor_id"]] = \
+                        payload.get("max_concurrency", 1)
                     return ("actor_ready", payload["actor_id"], None)
                 if payload["type"] == "exec_actor":
                     instance = self.actors[payload["actor_id"]]
@@ -231,11 +245,25 @@ class ExecutionEnv:
 
 def worker_main(conn, session: str, max_inline_bytes: int,
                 env_vars: Optional[dict] = None) -> None:
-    """Message loop of a process worker (conn already registered)."""
+    """Message loop of a process worker (conn already registered).
+
+    Actors created with ``max_concurrency > 1`` execute their calls on
+    a thread pool (ordering across in-flight calls is not guaranteed,
+    the reference's threaded-actor semantics); everything else runs on
+    the loop thread. All sends share one lock — Connection.send is not
+    thread-safe.
+    """
     if env_vars:
         os.environ.update(env_vars)
 
     env = ExecutionEnv(session, max_inline_bytes)
+    send_lock = threading.Lock()
+
+    def send(reply) -> None:
+        with send_lock:
+            conn.send(reply)
+
+    pool = None
     try:
         while True:
             try:
@@ -248,11 +276,22 @@ def worker_main(conn, session: str, max_inline_bytes: int,
             elif op == "func":
                 env.cache_function(msg[1], msg[2])
             elif op in ("exec", "create_actor", "exec_actor"):
-                reply = env.execute(msg[1], emit=conn.send)
-                conn.send(reply)
+                payload = msg[1]
+                conc = (env._actor_conc.get(payload.get("actor_id"), 1)
+                        if op == "exec_actor" else 1)
+                if conc > 1:
+                    if pool is None:
+                        from concurrent.futures import ThreadPoolExecutor
+                        pool = ThreadPoolExecutor(max_workers=32)
+                    pool.submit(
+                        lambda p=payload: send(env.execute(p, emit=send)))
+                else:
+                    send(env.execute(payload, emit=send))
             elif op == "ping":
-                conn.send(("pong",))
+                send(("pong",))
     finally:
+        if pool is not None:
+            pool.shutdown(wait=False)
         env.shm_client.close()
         try:
             conn.close()

@@ -1,0 +1,48 @@
+"""Threaded actors (max_concurrency): concurrent method execution.
+
+Reference analog: Ray's threaded actors
+(``@ray.remote(max_concurrency=N)``) [UNVERIFIED — mount empty,
+SURVEY.md §0]: up to N calls execute simultaneously; cross-call
+ordering is not guaranteed.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_threaded_actor_overlaps_calls(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Sleeper:
+        def nap(self, i):
+            time.sleep(1.0)
+            return i
+
+    s = Sleeper.remote()
+    ray_tpu.get(s.nap.remote(-1), timeout=120)   # warm the worker
+    t0 = time.monotonic()
+    refs = [s.nap.remote(i) for i in range(4)]
+    assert sorted(ray_tpu.get(refs, timeout=120)) == [0, 1, 2, 3]
+    wall = time.monotonic() - t0
+    assert wall < 3.0, f"calls did not overlap: {wall:.1f}s"
+
+
+def test_default_actor_stays_serial(ray_start_regular):
+    @ray_tpu.remote
+    class Serial:
+        def __init__(self):
+            self.inside = 0
+            self.max_inside = 0
+
+        def probe(self):
+            self.inside += 1
+            self.max_inside = max(self.max_inside, self.inside)
+            time.sleep(0.3)
+            self.inside -= 1
+            return self.max_inside
+
+    s = Serial.remote()
+    out = ray_tpu.get([s.probe.remote() for _ in range(4)], timeout=120)
+    assert max(out) == 1          # never two calls inside at once
