@@ -53,10 +53,9 @@ class _NoopRefCounter:
 class NestedClient:
     """Duck-type of the Worker surface the public API uses."""
 
-    def __init__(self, owner_addr: Tuple[str, int], task_id: bytes):
+    def __init__(self, owner_addr: Tuple[str, int]):
         from ray_tpu._private.rpc import RpcClient
         self._client = RpcClient(tuple(owner_addr))
-        self._task_id = task_id
         self.serde = serialization.get_context()
         self.reference_counter = _NoopRefCounter()
         self.session = f"nested-{owner_addr[1]}"
@@ -242,7 +241,9 @@ _nested_lock = threading.Lock()
 
 
 def get_nested_client() -> Optional[NestedClient]:
-    """The current task's owner channel, or None outside a task."""
+    """The current task's owner channel, or None outside a task. Task
+    identity is read per-call from the thread-local (see
+    ``NestedClient._current_task_id``), not bound to the client."""
     global _nested
     from ray_tpu._private.worker_process import _CURRENT_TASK
     addr = _CURRENT_TASK.get("owner_addr")
@@ -253,8 +254,5 @@ def get_nested_client() -> Optional[NestedClient]:
                 or not _nested._client.alive:
             if _nested is not None:
                 _nested.close()
-            _nested = NestedClient(tuple(addr),
-                                   _CURRENT_TASK.get("task_id", b""))
-        else:
-            _nested._task_id = _CURRENT_TASK.get("task_id", b"")
+            _nested = NestedClient(tuple(addr))
         return _nested

@@ -47,17 +47,28 @@ from ray_tpu._private.object_store import (
 )
 from ray_tpu.exceptions import TaskError
 
+# Process-level fallback: user code may spawn its OWN threads inside a
+# task and call the API from them; those threads inherit the process's
+# most-recent task identity (exact per-thread identity only matters for
+# blocked-parent resource release under max_concurrency>1).
+_TASK_FALLBACK: Dict[str, Any] = {"owner_addr": None, "task_id": b""}
+
+
 class _TaskLocal(threading.local):
     """Per-THREAD pointer at the currently-executing task's owner
     channel — thread-local because max_concurrency>1 actors execute
     calls on a pool, and nested API calls must bind to their own
-    task's identity."""
+    task's identity; threads the executor never tagged fall back to
+    the process-level value."""
 
     owner_addr = None
     task_id = b""
 
     def get(self, key, default=None):
-        return getattr(self, key, default)
+        value = getattr(self, key, None)
+        if value is None or value == b"":
+            value = _TASK_FALLBACK.get(key)
+        return default if value is None else value
 
 
 _CURRENT_TASK = _TaskLocal()
@@ -161,6 +172,8 @@ class ExecutionEnv:
         # by the user function (see _private/nested_client.py).
         _CURRENT_TASK.owner_addr = payload.get("owner_addr")
         _CURRENT_TASK.task_id = task_id
+        _TASK_FALLBACK["owner_addr"] = payload.get("owner_addr")
+        _TASK_FALLBACK["task_id"] = task_id
         try:
             fn = self._get_callable(payload)
             args, kwargs = self.resolve_args(payload["args"],
@@ -263,7 +276,7 @@ def worker_main(conn, session: str, max_inline_bytes: int,
         with send_lock:
             conn.send(reply)
 
-    pool = None
+    pools: Dict[bytes, Any] = {}   # actor_id -> its capped pool
     try:
         while True:
             try:
@@ -280,9 +293,15 @@ def worker_main(conn, session: str, max_inline_bytes: int,
                 conc = (env._actor_conc.get(payload.get("actor_id"), 1)
                         if op == "exec_actor" else 1)
                 if conc > 1:
+                    # one pool PER actor sized to its declared cap —
+                    # max_concurrency bounds in-flight calls, it is not
+                    # a boolean
+                    aid = payload["actor_id"]
+                    pool = pools.get(aid)
                     if pool is None:
                         from concurrent.futures import ThreadPoolExecutor
-                        pool = ThreadPoolExecutor(max_workers=32)
+                        pool = ThreadPoolExecutor(max_workers=conc)
+                        pools[aid] = pool
                     pool.submit(
                         lambda p=payload: send(env.execute(p, emit=send)))
                 else:
@@ -290,7 +309,7 @@ def worker_main(conn, session: str, max_inline_bytes: int,
             elif op == "ping":
                 send(("pong",))
     finally:
-        if pool is not None:
+        for pool in pools.values():
             pool.shutdown(wait=False)
         env.shm_client.close()
         try:

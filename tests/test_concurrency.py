@@ -29,6 +29,75 @@ def test_threaded_actor_overlaps_calls(ray_start_regular):
     assert wall < 3.0, f"calls did not overlap: {wall:.1f}s"
 
 
+def test_max_concurrency_is_a_cap(ray_start_regular):
+    """N is a CAP, not a boolean: an actor with max_concurrency=2
+    never runs more than 2 calls at once."""
+
+    @ray_tpu.remote(max_concurrency=2)
+    class Capped:
+        def __init__(self):
+            import threading
+            self.lock = threading.Lock()
+            self.inside = 0
+            self.max_inside = 0
+
+        def probe(self):
+            with self.lock:
+                self.inside += 1
+                self.max_inside = max(self.max_inside, self.inside)
+            time.sleep(0.4)
+            with self.lock:
+                self.inside -= 1
+                return self.max_inside
+
+    c = Capped.remote()
+    out = ray_tpu.get([c.probe.remote() for _ in range(6)], timeout=120)
+    assert max(out) == 2, out
+
+
+def test_tpu_actor_concurrency(ray_start_regular):
+    """In-process (TPU) actors honor max_concurrency too."""
+
+    @ray_tpu.remote(num_tpus=1, max_concurrency=3)
+    class DeviceActor:
+        def nap(self, i):
+            time.sleep(0.8)
+            return i
+
+    a = DeviceActor.remote()
+    ray_tpu.get(a.nap.remote(-1), timeout=120)
+    t0 = time.monotonic()
+    out = ray_tpu.get([a.nap.remote(i) for i in range(3)], timeout=120)
+    wall = time.monotonic() - t0
+    assert sorted(out) == [0, 1, 2]
+    assert wall < 2.0, f"in-process calls did not overlap: {wall:.1f}s"
+
+
+def test_nested_call_from_user_thread(ray_start_regular):
+    """User code spawning its own thread inside a task can still use
+    the API (process-level owner-channel fallback)."""
+
+    @ray_tpu.remote
+    def child():
+        return 21
+
+    @ray_tpu.remote
+    def parent():
+        import threading
+        import ray_tpu as rt
+        out = {}
+
+        def helper():
+            out["v"] = rt.get(child.remote()) * 2
+
+        t = threading.Thread(target=helper)
+        t.start()
+        t.join(timeout=120)
+        return out.get("v")
+
+    assert ray_tpu.get(parent.remote(), timeout=180) == 42
+
+
 def test_default_actor_stays_serial(ray_start_regular):
     @ray_tpu.remote
     class Serial:
