@@ -26,14 +26,36 @@ logger = logging.getLogger(__name__)
 
 
 class GcsClient:
+    """Survives a GCS restart: a call hitting a dead connection
+    reconnects (the restarted server reloads its persisted tables) and
+    re-subscribes before retrying once — the reference's
+    gcs-fault-tolerance client behavior."""
+
     def __init__(self, address: Tuple[str, int]):
         self.address = tuple(address)
         self.publisher = Publisher()
         self._actor_cache: Dict[ActorID, ActorInfo] = {}
         self._cache_lock = threading.Lock()
+        self._reconnect_lock = threading.Lock()
+        self._connect()
+
+    def _connect(self) -> None:
         self._client = RpcClient(self.address, on_push=self._on_push)
         for channel in ("NODE", "ACTOR", "RESOURCES"):
             self._client.call("subscribe", channel)
+
+    def _call(self, method: str, *args, timeout: float = 30.0):
+        try:
+            return self._client.call(method, *args, timeout=timeout)
+        except (ConnectionError, OSError, TimeoutError):
+            with self._reconnect_lock:
+                if not self._client.alive:
+                    from ray_tpu._private.rpc import wait_for_server
+                    wait_for_server(self.address, timeout=30.0)
+                    self._connect()
+            with self._cache_lock:
+                self._actor_cache.clear()
+            return self._client.call(method, *args, timeout=timeout)
 
     def _on_push(self, topic: str, message) -> None:
         if topic == "ACTOR":
@@ -48,19 +70,19 @@ class GcsClient:
     # -- jobs ----------------------------------------------------------
 
     def next_job_id(self) -> int:
-        return self._client.call("next_job_id")
+        return self._call("next_job_id")
 
     # -- nodes ---------------------------------------------------------
 
     def register_node(self, info: NodeInfo,
                       rpc_addr: Optional[Tuple[str, int]] = None) -> None:
-        self._client.call("register_node", info, rpc_addr)
+        self._call("register_node", info, rpc_addr)
 
     def remove_node(self, node_id: NodeID) -> None:
-        self._client.call("remove_node", node_id)
+        self._call("remove_node", node_id)
 
     def get_all_node_info(self) -> List[NodeInfo]:
-        return self._client.call("get_all_node_info")
+        return self._call("get_all_node_info")
 
     def report_resources(self, node_id: NodeID,
                          available: Dict[str, float]) -> None:
@@ -69,13 +91,13 @@ class GcsClient:
     # -- actors --------------------------------------------------------
 
     def register_actor(self, info: ActorInfo) -> None:
-        self._client.call("register_actor", info)
+        self._call("register_actor", info)
         with self._cache_lock:
             self._actor_cache[info.actor_id] = info
 
     def update_actor_state(self, actor_id: ActorID, state: str,
                            death_cause: str = "") -> None:
-        self._client.call("update_actor_state", actor_id, state, death_cause)
+        self._call("update_actor_state", actor_id, state, death_cause)
         with self._cache_lock:
             self._actor_cache.pop(actor_id, None)
 
@@ -84,7 +106,7 @@ class GcsClient:
             info = self._actor_cache.get(actor_id)
         if info is not None:
             return info
-        info = self._client.call("get_actor_info", actor_id)
+        info = self._call("get_actor_info", actor_id)
         if info is not None:
             with self._cache_lock:
                 self._actor_cache[actor_id] = info
@@ -92,24 +114,24 @@ class GcsClient:
 
     def get_named_actor(self, name: str, namespace: str
                         ) -> Optional[ActorInfo]:
-        return self._client.call("get_named_actor", name, namespace)
+        return self._call("get_named_actor", name, namespace)
 
     def list_actors(self) -> List[ActorInfo]:
-        return self._client.call("list_actors")
+        return self._call("list_actors")
 
     # -- internal KV ---------------------------------------------------
 
     def kv_put(self, key: bytes, value: bytes, namespace: str = "") -> None:
-        self._client.call("kv_put", key, value, namespace)
+        self._call("kv_put", key, value, namespace)
 
     def kv_get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
-        return self._client.call("kv_get", key, namespace)
+        return self._call("kv_get", key, namespace)
 
     def kv_del(self, key: bytes, namespace: str = "") -> None:
-        self._client.call("kv_del", key, namespace)
+        self._call("kv_del", key, namespace)
 
     def kv_keys(self, prefix: bytes, namespace: str = "") -> List[bytes]:
-        return self._client.call("kv_keys", prefix, namespace)
+        return self._call("kv_keys", prefix, namespace)
 
     def close(self) -> None:
         self._client.close()

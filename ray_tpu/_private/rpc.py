@@ -94,6 +94,8 @@ class RpcServer:
         self._handlers: Dict[str, Callable] = {}
         self._disconnect_cb: Optional[Callable[[ConnectionContext], None]] \
             = None
+        self._live_lock = threading.Lock()
+        self._live: set = set()
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -101,6 +103,8 @@ class RpcServer:
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 ctx = ConnectionContext(sock, self.client_address)
+                with outer._live_lock:
+                    outer._live.add(ctx)
                 try:
                     while True:
                         msg = _recv_frame(sock)
@@ -109,6 +113,8 @@ class RpcServer:
                     pass
                 finally:
                     ctx.alive = False
+                    with outer._live_lock:
+                        outer._live.discard(ctx)
                     if outer._disconnect_cb is not None:
                         try:
                             outer._disconnect_cb(ctx)
@@ -164,6 +170,20 @@ class RpcServer:
             self._server.server_close()
         except Exception:
             pass
+        # socketserver.shutdown only stops the accept loop; live
+        # per-connection threads keep serving until their socket dies.
+        # Close them so clients see EOF and this server truly stops.
+        with self._live_lock:
+            live = list(self._live)
+        for ctx in live:
+            try:
+                ctx._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                ctx._sock.close()
+            except OSError:
+                pass
 
 
 class RpcClient:

@@ -137,6 +137,38 @@ def test_gcs_restart_recovers_persisted_state(tmp_path):
         reborn.shutdown()
 
 
+def test_gcs_client_survives_gcs_restart(tmp_path):
+    """Clients reconnect to a restarted GCS and see its persisted
+    tables (reference: test_gcs_fault_tolerance semantics)."""
+    import socket
+
+    from ray_tpu._private.gcs_client import GcsClient
+    from ray_tpu._private.gcs_server import GcsServer
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    path = str(tmp_path / "gcs_state.bin")
+
+    server = GcsServer(port=port, persist_path=path)
+    client = GcsClient(("127.0.0.1", port))
+    client.kv_put(b"alpha", b"1", "ns")
+    time.sleep(0.5)          # let the persist loop snapshot
+    server.shutdown()
+    time.sleep(0.2)
+
+    reborn = GcsServer(port=port, persist_path=path)
+    try:
+        # same client object: the dead connection reconnects + retries
+        assert client.kv_get(b"alpha", "ns") == b"1"
+        client.kv_put(b"beta", b"2", "ns")
+        assert reborn.state.kv_get(b"beta", "ns") == b"2"
+        client.close()
+    finally:
+        reborn.shutdown()
+
+
 def test_gcs_health_check_declares_silent_node_dead():
     """A node registered with an unreachable RPC address is declared
     dead after health_check_failure_threshold missed pings."""
