@@ -156,6 +156,43 @@ class Dataset:
             if merged.num_rows:
                 yield blib.block_to_batch(merged, batch_format)
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device: Optional[str] = None,
+                           drop_last: bool = False) -> Iterator[Any]:
+        """numpy batches converted to torch tensors (reference:
+        ``Dataset.iter_torch_batches`` feeding TorchTrainer loops)."""
+        import torch
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            out = {}
+            for key, arr in batch.items():
+                t = torch.as_tensor(arr)
+                if dtypes is not None:
+                    want = (dtypes.get(key) if isinstance(dtypes, dict)
+                            else dtypes)
+                    if want is not None:
+                        t = t.to(want)
+                if device is not None:
+                    t = t.to(device)
+                out[key] = t
+            yield out
+
+    def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
+                         sharding=None,
+                         drop_last: bool = False) -> Iterator[Any]:
+        """numpy batches placed as jax arrays, optionally with a
+        target sharding (feeds pjit train steps directly)."""
+        import jax
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            if sharding is None:
+                yield {k: jax.numpy.asarray(v) for k, v in batch.items()}
+            else:
+                yield {k: jax.device_put(v, sharding)
+                       for k, v in batch.items()}
+
     def iter_rows(self) -> Iterator[Any]:
         for blk in self.iter_blocks():
             yield from blib.batch_to_rows(blk)

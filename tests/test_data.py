@@ -133,3 +133,26 @@ def test_map_batches_actor_pool():
         AddConst, fn_args=(100,), concurrency=2)
     out = sorted(r["id"] for r in ds.take_all())
     assert out == list(range(100, 132))
+
+
+def test_iter_torch_and_jax_batches(ray_start_regular):
+    import numpy as np
+    import torch
+
+    from ray_tpu import data as rdata
+
+    ds = rdata.range(100)
+    seen = 0
+    for batch in ds.iter_torch_batches(batch_size=32,
+                                       dtypes=torch.float32):
+        assert isinstance(batch["id"], torch.Tensor)
+        assert batch["id"].dtype == torch.float32
+        seen += batch["id"].shape[0]
+    assert seen == 100
+
+    import jax
+    total = 0.0
+    for batch in rdata.range(10).iter_jax_batches(batch_size=4):
+        assert isinstance(batch["id"], jax.Array)
+        total += float(batch["id"].sum())
+    assert total == float(np.arange(10).sum())
