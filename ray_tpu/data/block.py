@@ -73,6 +73,11 @@ def block_to_batch(block: Block, batch_format: BatchFormat = "numpy"):
         return block
     if batch_format == "pandas":
         return block.to_pandas()
+    if batch_format != "numpy":
+        raise ValueError(
+            f"unknown batch_format {batch_format!r}; use 'numpy', "
+            "'pyarrow', 'pandas' (device arrays: "
+            "Dataset.iter_jax_batches / iter_torch_batches)")
     out: Dict[str, np.ndarray] = {}
     for name in block.column_names:
         col = block.column(name)

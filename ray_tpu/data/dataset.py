@@ -3,8 +3,8 @@
 Reference surface: ``python/ray/data/dataset.py`` + ``read_api.py``
 [UNVERIFIED — mount empty, SURVEY.md §0]. Laziness, operator fusion,
 streaming execution, and the blocks-in-object-store model match; the
-TPU-native extension is ``iter_batches(format="jax")`` handing back
-device-ready arrays.
+TPU-native extension is ``iter_jax_batches`` handing back device-ready
+(optionally sharded) arrays, alongside ``iter_torch_batches``.
 """
 
 from __future__ import annotations
@@ -168,13 +168,12 @@ class Dataset:
             out = {}
             for key, arr in batch.items():
                 t = torch.as_tensor(arr)
+                want = None
                 if dtypes is not None:
                     want = (dtypes.get(key) if isinstance(dtypes, dict)
                             else dtypes)
-                    if want is not None:
-                        t = t.to(want)
-                if device is not None:
-                    t = t.to(device)
+                if want is not None or device is not None:
+                    t = t.to(device=device, dtype=want)
                 out[key] = t
             yield out
 
