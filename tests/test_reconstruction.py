@@ -97,6 +97,7 @@ def test_reconstruction_budget_exhausted(ray_start_regular):
 def test_reconstruct_lost_spill_file():
     """A spilled object whose spill file vanished reconstructs
     transparently on get()."""
+    ray_tpu.shutdown()   # a leaked runtime would make init() a no-op
     w = ray_tpu.init(num_cpus=4, object_store_memory=6 * 1024 * 1024,
                      max_process_workers=2)
     try:
