@@ -270,6 +270,184 @@ def bench_pg_pack(avail, total, alive, rng):
     return kernel_rate, python_rate
 
 
+def bench_e2e_runtime():
+    """End-to-end runtime numbers through the FULL hot path —
+    submit → schedule → lease → worker process → result — on a live
+    runtime, the analog of `ray microbenchmark`
+    (reference ``python/ray/_private/ray_perf.py``): serial round-trip
+    p50/p99 for config-1 pi tasks, pipelined task throughput, and
+    actor calls/s. Returns a dict of fields (empty on failure — bench
+    must never die on the runtime section)."""
+    out = {}
+    try:
+        import ray_tpu
+        ray_tpu.init(num_cpus=8, max_process_workers=4)
+
+        @ray_tpu.remote
+        def pi_task(n=100):
+            import random
+            inside = 0
+            for _ in range(n):
+                x, y = random.random(), random.random()
+                inside += x * x + y * y <= 1.0
+            return 4.0 * inside / n
+
+        # Warm the worker pool (process spawn is seconds; steady-state
+        # dispatch is what the reference benchmark measures too).
+        ray_tpu.get([pi_task.remote() for _ in range(16)])
+
+        # (a) serial submit→result round trip.
+        lats = []
+        for _ in range(200):
+            t0 = time.perf_counter()
+            ray_tpu.get(pi_task.remote())
+            lats.append(time.perf_counter() - t0)
+        lats = np.array(lats)
+        out["e2e_roundtrip_p50_ms"] = round(
+            float(np.percentile(lats, 50)) * 1e3, 3)
+        out["e2e_roundtrip_p99_ms"] = round(
+            float(np.percentile(lats, 99)) * 1e3, 3)
+
+        # (b) pipelined throughput: one submit wave, one drain.
+        n = 2000
+        t0 = time.perf_counter()
+        refs = [pi_task.remote() for _ in range(n)]
+        ray_tpu.get(refs)
+        dt = time.perf_counter() - t0
+        out["e2e_tasks_per_sec"] = round(n / dt, 1)
+
+        # (c) actor calls: serial latency + pipelined calls/s.
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def ping(self):
+                self.n += 1
+                return self.n
+
+        a = Counter.remote()
+        ray_tpu.get(a.ping.remote())          # actor process up
+        t0 = time.perf_counter()
+        m = 2000
+        refs = [a.ping.remote() for _ in range(m)]
+        assert ray_tpu.get(refs)[-1] == m + 1
+        out["actor_calls_per_sec"] = round(m / (time.perf_counter() - t0),
+                                           1)
+    except Exception as e:
+        print(f"# e2e runtime bench failed: {e!r}", file=sys.stderr)
+    finally:
+        try:
+            import ray_tpu
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+    return out
+
+
+_PEAK_BF16_TFLOPS = {
+    # marketing peak bf16 TFLOP/s per chip, keyed on device_kind prefix
+    "TPU v6": 918.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v5": 459.0,
+    "TPU v4 lite": 138.0,
+    "TPU v4": 275.0,
+    "TPU v3": 123.0,
+    "TPU v2": 46.0,
+}
+
+
+def bench_model_mfu():
+    """Flagship-transformer training-step time and MFU% on the real
+    chip. K steps run inside ONE jitted lax.scan so the tunnel/dispatch
+    round trip (~100 ms on remote-attached chips) amortizes away and
+    the measurement is device time. FLOPs come from XLA's own
+    cost_analysis when available, else the 6·N·T + 12·L·d·T² formula.
+    """
+    out = {}
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            print(f"# mfu bench skipped: no TPU (platform={dev.platform})",
+                  file=sys.stderr)
+            return out
+        from ray_tpu.models import (
+            TransformerConfig, init_state, make_optimizer, make_train_step)
+
+        cfg = TransformerConfig(
+            vocab_size=32_768, d_model=512, n_layers=8, n_heads=8,
+            n_kv_heads=8, d_ff=2048, max_seq_len=1024)
+        batch, seq = 8, 1024
+        k_lo, k_hi = 4, 24
+        tx = make_optimizer(total_steps=1000)
+        state = init_state(jax.random.PRNGKey(0), cfg, tx)
+        step = make_train_step(cfg, tx, donate=False)
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                             (batch, seq), np.int32))
+
+        def make_k(k_steps):
+            def k_step(state, tokens):
+                def body(s, _):
+                    s, metrics = step(s, {"tokens": tokens})
+                    return s, metrics["loss"]
+                return jax.lax.scan(body, state, None, length=k_steps)
+            return jax.jit(k_step)
+
+        def timed(k_jit):
+            # np.asarray forces the d2h materialization: on
+            # remote-attached chips block_until_ready alone can return
+            # before the computation actually retires.
+            t0 = time.perf_counter()
+            _, losses = k_jit(state, tokens)
+            losses = np.asarray(losses)
+            assert np.isfinite(losses[-1])
+            return time.perf_counter() - t0
+
+        lo_jit, hi_jit = make_k(k_lo), make_k(k_hi)
+        timed(lo_jit), timed(hi_jit)                 # compile + warm
+        # Slope timing: (t_hi - t_lo) / (k_hi - k_lo) cancels the fixed
+        # per-invocation cost (dispatch + tunnel round trip + transfer).
+        t_lo = min(timed(lo_jit) for _ in range(3))
+        t_hi = min(timed(hi_jit) for _ in range(3))
+        step_s = max(t_hi - t_lo, 1e-9) / (k_hi - k_lo)
+
+        flops_per_step = None
+        try:
+            cost = jax.jit(step).lower(
+                state, {"tokens": tokens}).compile().cost_analysis()
+            if isinstance(cost, list):
+                cost = cost[0]
+            flops_per_step = float(cost.get("flops", 0.0)) or None
+        except Exception:
+            pass
+        if not flops_per_step:
+            n_params = sum(int(np.prod(p.shape))
+                           for p in jax.tree.leaves(state.params))
+            tokens_per_step = batch * seq
+            flops_per_step = (6.0 * n_params * tokens_per_step
+                              + 12.0 * cfg.n_layers * cfg.d_model
+                              * tokens_per_step * seq)
+
+        peak = next((v for k, v in _PEAK_BF16_TFLOPS.items()
+                     if dev.device_kind.startswith(k)), 100.0) * 1e12
+        print(f"# mfu: flops/step={flops_per_step:.3e} "
+              f"step={step_s * 1e3:.2f}ms peak={peak:.2e}",
+              file=sys.stderr)
+        out["model_step_ms"] = round(step_s * 1e3, 2)
+        out["model_tokens_per_sec"] = round(batch * seq / step_s, 1)
+        out["model_mfu_pct"] = round(
+            100.0 * flops_per_step / (step_s * peak), 2)
+        out["model_device"] = dev.device_kind
+    except Exception as e:
+        print(f"# mfu bench failed: {e!r}", file=sys.stderr)
+    return out
+
+
 def main():
     rng = np.random.RandomState(42)
     avail, total, alive = build_cluster_arrays(rng)
@@ -286,8 +464,10 @@ def main():
     # Heavy-load p99 (the north-star workload itself, 1M pending): a
     # task's dispatch latency is its wait until assignment. The TPU
     # kernel drains every placeable task in ONE invocation, so p99 =
-    # invocation wall time; the CPU baseline dispatches sequentially at
-    # cpu_rate, so the p99 task waits for 99% of the queue ahead of it.
+    # invocation wall time (measured); the CPU baseline p99 is MODELED,
+    # not measured: sequential dispatch at the measured cpu_rate means
+    # the p99 task waits for 99% of the queue ahead of it (draining the
+    # full 1M through the scalar loop would take minutes per run).
     heavy_p99_tpu_s = max(tpu_times)
     heavy_p99_cpu_s = 0.99 * n_scheduled / cpu_rate
 
@@ -299,7 +479,10 @@ def main():
         # Second north-star number, both regimes. >= 1 means the TPU
         # build's p99 is at or below the CPU baseline's.
         "p99_heavy_load_s": round(heavy_p99_tpu_s, 3),
-        "p99_heavy_vs_baseline": round(heavy_p99_cpu_s / heavy_p99_tpu_s, 1),
+        # baseline side of this ratio is modeled from the measured CPU
+        # rate (see comment above), not a measured drain
+        "p99_heavy_vs_baseline_modeled": round(
+            heavy_p99_cpu_s / heavy_p99_tpu_s, 1),
         "p99_light_load_us": round(light_p99_us, 1),
         # fraction of the 1M pending tasks the 10k-node cluster had
         # capacity to place this round (the rest stay queued).
@@ -313,6 +496,8 @@ def main():
         record["p99_light_baseline_us"] = round(light_base_us, 1)
         record["p99_light_vs_baseline"] = round(light_base_us / light_p99_us,
                                                 2)
+    record.update(bench_e2e_runtime())
+    record.update(bench_model_mfu())
     print(json.dumps(record))
     print(f"# scheduled {n_scheduled} of {N_TASKS} pending; "
           f"cpu baseline {cpu_rate:.1f} tasks/s (sample {BASELINE_SAMPLE}); "

@@ -47,9 +47,19 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
         self._node_order: List[NodeID] = []
         self._node_index: Dict[NodeID, int] = {}
         self._res_names: List[str] = []
+        self._res_index: Dict[str, int] = {}
         self._total: Optional[np.ndarray] = None
         self._alive: Optional[np.ndarray] = None
         self._avail: Optional[np.ndarray] = None
+        # Single-task fast-path state: preallocated in/out buffers and
+        # cached ctypes pointers (refreshed on _rebuild), so the p99 of
+        # a light-load schedule() is the native scan itself, not Python
+        # buffer assembly + a [nodes, resources] copy per call.
+        self._ptrs: Optional[Tuple] = None
+        self._one_dem: Optional[np.ndarray] = None
+        self._one_pref = np.full(1, -1, np.int32)
+        self._one_out = np.empty(1, np.int32)
+        self._one_inf = np.empty(1, np.uint8)
 
     def _write_row(self, i: int, node) -> None:
         self._alive[i] = 1 if node.alive else 0
@@ -58,9 +68,11 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
             self._avail[i, j] = node.available.get(name, 0.0)
 
     def _rebuild(self, cluster: ClusterResourceManager, version: int):
+        import ctypes as ct
         snap = cluster.snapshot()
         names = sorted({k for node in snap.values() for k in node.total})
         self._res_names = names
+        self._res_index = {name: j for j, name in enumerate(names)}
         self._node_order = list(snap.keys())
         self._node_index = {nid: i for i, nid in enumerate(self._node_order)}
         n, r = len(self._node_order), max(len(names), 1)
@@ -70,10 +82,20 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
         for i, nid in enumerate(self._node_order):
             self._write_row(i, snap[nid])
         self._cached_version = version
+        self._one_dem = np.zeros((1, r), np.float32)
+        f32p = ct.POINTER(ct.c_float)
+        u8p = ct.POINTER(ct.c_uint8)
+        i32p = ct.POINTER(ct.c_int32)
+        self._ptrs = (self._avail.ctypes.data_as(f32p),
+                      self._total.ctypes.data_as(f32p),
+                      self._alive.ctypes.data_as(u8p),
+                      self._one_dem.ctypes.data_as(f32p),
+                      self._one_pref.ctypes.data_as(i32p),
+                      self._one_out.ctypes.data_as(i32p),
+                      self._one_inf.ctypes.data_as(u8p))
 
-    def _matrices(self, cluster: ClusterResourceManager) -> np.ndarray:
-        """Sync the cached matrices to the cluster; returns a private
-        copy of avail (the native batch loop mutates it)."""
+    def _sync(self, cluster: ClusterResourceManager) -> None:
+        """Bring the cached matrices up to the cluster's version."""
         version = cluster.version()
         if self._avail is None:
             self._rebuild(cluster, version)
@@ -97,7 +119,45 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
                     self._write_row(i, node)
                 else:
                     self._cached_version = version
+
+    def _matrices(self, cluster: ClusterResourceManager) -> np.ndarray:
+        """Sync the cached matrices to the cluster; returns a private
+        copy of avail (the native batch loop mutates it)."""
+        self._sync(cluster)
         return self._avail.copy()
+
+    def schedule(self, cluster: ClusterResourceManager,
+                 request: SchedulingRequest) -> SchedulingResult:
+        """Single-task fast path: the native scan runs directly on the
+        cached availability matrix (no copy) and the one row the native
+        loop debits is credited back — the cluster ledger, not this
+        cache, is the authority for commits."""
+        self._sync(cluster)
+        res_index = self._res_index
+        for k in request.demand:
+            if k not in res_index:
+                return SchedulingResult(None, is_infeasible=True)
+        dem = self._one_dem
+        dem[0, :] = 0.0
+        for k, v in request.demand.items():
+            dem[0, res_index[k]] = v
+        pref = -1
+        if request.preferred_node is not None and not request.avoid_local:
+            pref = self._node_index.get(request.preferred_node, -1)
+        self._one_pref[0] = pref
+        import ctypes as ct
+        availp, totalp, alivep, demp, prefp, outp, infp = self._ptrs
+        self._lib.rtpu_hybrid_schedule(
+            availp, totalp, alivep,
+            self._avail.shape[0], self._avail.shape[1],
+            demp, prefp, 1, ct.c_float(self._threshold), self._top_k_abs,
+            ct.c_float(self._top_k_frac), self._seed, outp, infp)
+        i = int(self._one_out[0])
+        if i < 0:
+            return SchedulingResult(
+                None, is_infeasible=bool(self._one_inf[0]))
+        self._avail[i] += dem[0]      # undo the native loop's debit
+        return SchedulingResult(self._node_order[i])
 
     def schedule_batch(self, cluster: ClusterResourceManager,
                        requests: Sequence[SchedulingRequest]
@@ -111,11 +171,12 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
         # be allocated from the shared batch-availability view, spuriously
         # denying capacity to later requests in the same batch. Filter
         # them out and splice results back by position.
+        res_index = self._res_index
         unknown: Dict[int, bool] = {}
         kept: List[int] = []
         for t, req in enumerate(requests):
             for k in req.demand:
-                if k not in self._res_names:
+                if k not in res_index:
                     unknown[t] = True
                     break
             if t not in unknown:
@@ -126,7 +187,7 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
         for row, t in enumerate(kept):
             req = requests[t]
             for k, v in req.demand.items():
-                demands[row, self._res_names.index(k)] = v
+                demands[row, res_index[k]] = v
             if req.preferred_node is not None and not req.avoid_local:
                 preferred[row] = node_index.get(req.preferred_node, -1)
         out_nodes = np.empty(max(nreq, 1), np.int32)

@@ -7,10 +7,11 @@ softmax keeps running (max, normalizer) so the S×S score matrix never
 materializes in HBM (HBM bandwidth is the bottleneck, not FLOPs).
 
 Forward is the Pallas kernel (grid over [batch×heads, query blocks],
-KV streamed through VMEM in blocks); backward recomputes attention via
-the reference formula under ``jax.vjp`` — exact gradients, no stored
-probabilities, trading recompute FLOPs for HBM exactly like
-``jax.checkpoint`` does.
+KV streamed through VMEM in blocks, saving only (O, LSE) residuals);
+backward is a Pallas FlashAttention-2 backward — blockwise dq/dk/dv
+recomputed from (O, LSE), so no S×S probability matrix ever touches
+HBM in either direction. Gradients are exact (grad-checked against the
+dense reference in tests/test_attention.py).
 
 Layout everywhere: [B, S, N, H].
 """
