@@ -13,11 +13,22 @@ their libraries; this module is the runtime-core tier only.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from typing import Any, Callable, Dict
 
 _ENV_PREFIX = "RAY_TPU_"
+
+
+def _apply_log_level(values: Dict[str, Any]) -> None:
+    level = values.get("log_level")
+    if level:
+        try:
+            logging.getLogger("ray_tpu").setLevel(level.upper())
+        except ValueError:
+            logging.getLogger(__name__).warning(
+                "invalid log_level %r; keeping current level", level)
 
 
 def _parse_bool(s: str) -> bool:
@@ -41,6 +52,7 @@ class Config:
         self._values: Dict[str, Any] = {}
         self._lock = threading.Lock()
         self._load_env()
+        _apply_log_level(self._values)
 
     @classmethod
     def define(cls, name: str, typ: type, default: Any, doc: str = ""):
@@ -67,6 +79,7 @@ class Config:
                 if isinstance(value, str) and typ is not str:
                     value = _PARSERS[typ](value)
                 self._values[name] = typ(value)
+            _apply_log_level(self._values)
 
     def serialize(self) -> str:
         return json.dumps(self._values)
@@ -74,6 +87,7 @@ class Config:
     def load_serialized(self, payload: str):
         with self._lock:
             self._values.update(json.loads(payload))
+            _apply_log_level(self._values)
 
     def __getattr__(self, name: str) -> Any:
         try:
@@ -85,6 +99,7 @@ class Config:
         with self._lock:
             self._values.clear()
             self._load_env()
+            _apply_log_level(self._values)
 
 
 _D = Config.define

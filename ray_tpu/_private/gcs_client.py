@@ -47,7 +47,13 @@ class GcsClient:
     def _call(self, method: str, *args, timeout: float = 30.0):
         try:
             return self._client.call(method, *args, timeout=timeout)
-        except (ConnectionError, OSError, TimeoutError):
+        except (ConnectionError, OSError, TimeoutError) as e:
+            # Retry only on connection loss. A timeout with the connection
+            # still alive means a slow server may yet execute the request;
+            # re-sending a non-idempotent mutation (next_job_id,
+            # register_actor) would apply it twice.
+            if isinstance(e, TimeoutError) and self._client.alive:
+                raise
             with self._reconnect_lock:
                 if not self._client.alive:
                     from ray_tpu._private.rpc import wait_for_server

@@ -2,19 +2,22 @@
 
 Reference: every Ray worker embeds a full CoreWorker, so user code can
 call ``ray.remote/get/put/wait`` from anywhere [UNVERIFIED — mount
-empty, SURVEY.md §0]. This runtime keeps workers as executors and
-serves the core API from the OWNER instead: a worker-side client
-speaking to the driver's nested-API handlers over the wire
-(``Worker._register_nested_handlers``). Ownership of every object and
-task stays with the driver — lineage, reconstruction, and refcounting
-need no distributed protocol.
+empty, SURVEY.md §0]. Split ownership model (round 3):
 
-Deadlock avoidance: a nested ``get`` reports the calling task's id;
-the owner releases that task's resource allocation and lends its node
-one extra worker slot while the parent blocks (the reference's
-CPU-release-while-blocked).
+- **Objects this worker creates (`put`) are OWNED HERE** — stored in
+  the process's ``WorkerCore`` (``_private/worker_core.py``), counted
+  here, served to peers owner-direct. The driver is not in the data
+  path of a worker→worker handoff, and owner death loses the objects
+  (reference semantics).
+- **Task/actor submission and task returns** ride the driver's
+  nested-API handlers (``Worker._register_nested_handlers``): the
+  driver is this framework's scheduling plane by design
+  (ARCHITECTURE.md §2), and return-object ownership stays with it.
 
-Actors cannot yet be created or called from inside tasks.
+Deadlock avoidance: a nested ``get`` against the driver reports the
+calling task's id; the owner releases that task's CPU allocation and
+lends its node one extra worker slot while the parent blocks (the
+reference's CPU-release-while-blocked).
 """
 
 from __future__ import annotations
@@ -84,7 +87,11 @@ class NestedClient:
         arg_descs = []
         for value in list(args) + [kwargs[k] for k in kwargs_keys]:
             if isinstance(value, ObjectRef):
-                arg_descs.append(("r", value.binary()))
+                owner = value.owner_addr()
+                if owner is not None:
+                    arg_descs.append(("ro", value.binary(), tuple(owner)))
+                else:
+                    arg_descs.append(("r", value.binary()))
             else:
                 arg_descs.append(
                     ("v", self.serde.serialize(value).to_bytes()))
@@ -119,6 +126,44 @@ class NestedClient:
 
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
+        # Worker-owned refs resolve owner-direct — no driver hop — the
+        # decentralized-ownership data path. One batched round trip per
+        # owner; the user timeout is a shared deadline, not per-ref.
+        if not any(r.owner_addr() is not None for r in refs):
+            return self._get_driver(refs, timeout)
+        import time as _time
+        from collections import defaultdict
+
+        from ray_tpu._private import worker_core
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        out: List[Any] = [None] * len(refs)
+        by_owner = defaultdict(list)
+        driver_refs, driver_idx = [], []
+        for i, r in enumerate(refs):
+            if r.owner_addr() is None:
+                driver_refs.append(r)
+                driver_idx.append(i)
+            else:
+                by_owner[r.owner_addr()].append(i)
+        for owner, idxs in by_owner.items():
+            remaining = None
+            if deadline is not None:
+                remaining = max(0.0, deadline - _time.monotonic())
+            values = worker_core.fetch_values_from_owner(
+                owner, [refs[i].id() for i in idxs], remaining)
+            for i, v in zip(idxs, values):
+                out[i] = v
+        if driver_refs:
+            remaining = None
+            if deadline is not None:
+                remaining = max(0.0, deadline - _time.monotonic())
+            for i, v in zip(driver_idx,
+                            self._get_driver(driver_refs, remaining)):
+                out[i] = v
+        return out
+
+    def _get_driver(self, refs: Sequence[ObjectRef],
+                    timeout: Optional[float]) -> List[Any]:
         rpc_timeout = None if timeout is None else timeout + 30.0
         status, items = self._client.call(
             "nested_get", self._current_task_id(),
@@ -136,17 +181,34 @@ class NestedClient:
         return out
 
     def put(self, value: Any) -> ObjectRef:
-        blob = self.serde.serialize(value).to_bytes()
-        oid_b = self._client.call("nested_put", blob)
-        return ObjectRef(ObjectID(oid_b))
+        # The creating worker OWNS the object (reference semantics):
+        # stored in this process's WorkerCore, served owner-direct.
+        from ray_tpu._private import worker_core
+        return worker_core.get_worker_core().put(value)
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None):
-        rpc_timeout = None if timeout is None else timeout + 30.0
-        ready_b = self._client.call(
-            "nested_wait", [r.id().binary() for r in refs], num_returns,
-            timeout, timeout=rpc_timeout)
-        ready_set = {ObjectID(b) for b in ready_b}
+        from ray_tpu._private import worker_core
+        owned_ready = set()
+        driver_refs = []
+        for r in refs:
+            owner = r.owner_addr()
+            if owner is None:
+                driver_refs.append(r)
+                continue
+            try:
+                if worker_core.owner_contains(owner, r.id()):
+                    owned_ready.add(r.id())
+            except Exception:
+                owned_ready.add(r.id())   # dead owner: get() will raise
+        ready_set = set(owned_ready)
+        need = max(0, num_returns - len(owned_ready))
+        if driver_refs:
+            rpc_timeout = None if timeout is None else timeout + 30.0
+            ready_b = self._client.call(
+                "nested_wait", [r.id().binary() for r in driver_refs],
+                need, timeout, timeout=rpc_timeout)
+            ready_set |= {ObjectID(b) for b in ready_b}
         ready, not_ready = [], []
         for r in refs:
             (ready if r.id() in ready_set and len(ready) < num_returns

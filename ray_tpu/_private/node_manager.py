@@ -433,6 +433,12 @@ class NodeManagerGroup:
             if arg.object_id is None:
                 arg_descs.append(("v", arg.inline_blob))
                 continue
+            if arg.owner_addr is not None:
+                # Worker-owned: the executing worker fetches from the
+                # owner directly — the driver never touches the bytes.
+                arg_descs.append(("owned", arg.object_id.binary(),
+                                  tuple(arg.owner_addr)))
+                continue
             oid = arg.object_id
             try:
                 entry = self._memory_store.get(oid, timeout=0)
@@ -805,6 +811,15 @@ class NodeManagerGroup:
         else:
             self.cluster_resources.free(node_id, resources)
 
+    def reacquire_allocation(self, node_id: NodeID,
+                             resources: Dict[str, float], pg=None) -> None:
+        """Take back resources a blocked parent task released while it
+        waited on a nested get()."""
+        if pg is not None and self.pg_manager is not None:
+            self.pg_manager.reacquire_from_bundle(pg[0], pg[1], resources)
+        else:
+            self.cluster_resources.reacquire(node_id, resources)
+
     def _schedule_pg_task(self, spec: TaskSpec, retry: List[TaskSpec]
                           ) -> None:
         """Route a task bound to a placement group: draw from the
@@ -988,6 +1003,10 @@ class NodeManagerGroup:
         for arg in spec.args:
             if arg.object_id is None:
                 arg_descs.append(("v", arg.inline_blob))
+                continue
+            if arg.owner_addr is not None:
+                arg_descs.append(("owned", arg.object_id.binary(),
+                                  tuple(arg.owner_addr)))
                 continue
             try:
                 entry = self._memory_store.get(arg.object_id, timeout=0)

@@ -15,12 +15,17 @@ from ray_tpu._private.ids import ObjectID, TaskID
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owner_hint", "__weakref__")
+    __slots__ = ("_id", "_owner_addr", "_counted", "__weakref__")
 
-    def __init__(self, object_id: ObjectID, owner_hint: Optional[bytes] = None,
+    def __init__(self, object_id: ObjectID,
+                 owner_addr: Optional[tuple] = None,
                  _count: bool = True):
         self._id = object_id
-        self._owner_hint = owner_hint
+        # (host, port) of the owning worker's core port; None = owned by
+        # the driver (the round-2 central model, still the default for
+        # driver-created objects and task returns).
+        self._owner_addr = tuple(owner_addr) if owner_addr else None
+        self._counted = bool(_count)
         if _count:
             _on_ref_created(self)
 
@@ -28,6 +33,9 @@ class ObjectRef:
 
     def id(self) -> ObjectID:
         return self._id
+
+    def owner_addr(self) -> Optional[tuple]:
+        return self._owner_addr
 
     def binary(self) -> bytes:
         return self._id.binary()
@@ -74,16 +82,20 @@ class ObjectRef:
     # -- lifetime ----------------------------------------------------------
 
     def __del__(self):
+        # Symmetric with creation: only refs that registered a count
+        # release one (uncounted refs are transient wire shims).
+        if not self._counted:
+            return
         try:
-            _on_ref_deleted(self._id)
+            _on_ref_deleted(self)
         except Exception:
             pass
 
     def __reduce__(self):
         # Capturing a ref inside a serialized value => borrow.
         from ray_tpu._private import serialization
-        serialization.get_context().note_contained_ref(self._id)
-        return (_deserialize_ref, (self._id.binary(),))
+        serialization.get_context().note_contained_ref(self)
+        return (_deserialize_ref, (self._id.binary(), self._owner_addr))
 
 
 class ObjectRefGenerator:
@@ -130,18 +142,51 @@ class ObjectRefGenerator:
                 f"next_index={self._i})")
 
 
-def _deserialize_ref(binary: bytes) -> "ObjectRef":
-    return ObjectRef(ObjectID(binary))
+def _deserialize_ref(binary: bytes, owner_addr=None) -> "ObjectRef":
+    return ObjectRef(ObjectID(binary), owner_addr=owner_addr)
+
+
+def adopt_preregistered_ref(oid_binary: bytes, owner_addr) -> "ObjectRef":
+    """Build a ref whose borrow the SENDER already registered with the
+    owner on the recipient's behalf (borrow handed off with the
+    message): skip the create-side registration but do release on
+    death."""
+    ref = ObjectRef(ObjectID(oid_binary), owner_addr=owner_addr,
+                    _count=False)
+    ref._counted = True
+    return ref
 
 
 def _on_ref_created(ref: ObjectRef) -> None:
+    owner = ref._owner_addr
+    if owner is not None:
+        # Worker-owned object: count at the owner. Local refs in the
+        # owner's own process use its WorkerCore counter; refs born in
+        # any other process register a borrow over the wire.
+        from ray_tpu._private import worker_core
+        core = worker_core.try_worker_core()
+        if core is not None and owner == core.address:
+            core.on_local_ref(ref.id())
+        else:
+            worker_core.register_borrow(owner, ref.id())
+        return
     from ray_tpu._private.worker import try_global_worker
     w = try_global_worker()
     if w is not None:
         w.reference_counter.add_local_reference(ref.id())
 
 
-def _on_ref_deleted(object_id: ObjectID) -> None:
+def _on_ref_deleted(ref: ObjectRef) -> None:
+    object_id = ref._id
+    owner = ref._owner_addr
+    if owner is not None:
+        from ray_tpu._private import worker_core
+        core = worker_core.try_worker_core()
+        if core is not None and owner == core.address:
+            core.on_local_unref(object_id)
+        else:
+            worker_core.release_borrow(owner, object_id)
+        return
     from ray_tpu._private.worker import try_global_worker
     w = try_global_worker()
     if w is not None:

@@ -318,6 +318,30 @@ class PlacementGroupManager:
                     return None, "busy"
             return None, "infeasible"
 
+    def reacquire_from_bundle(self, pg_id: PlacementGroupID,
+                              bundle_index: int,
+                              demand: ResourceRequest) -> None:
+        """Unconditionally re-draw ``demand`` from a bundle after a
+        blocked task resumes (see ClusterResources.reacquire). If the
+        group dissolved while the task was blocked its reservation was
+        already returned to the node, so the debit lands on the node —
+        mirror image of free_to_bundle's REMOVED branch."""
+        with self._lock:
+            info = self._groups.get(pg_id)
+            if info is None:
+                return
+            if info.state == "REMOVED" or bundle_index >= len(
+                    info.bundle_avail):
+                if bundle_index < len(info.bundle_nodes):
+                    node_id = info.bundle_nodes[bundle_index]
+                else:
+                    return
+                self._cluster.reacquire(node_id, demand)
+                return
+            avail = info.bundle_avail[bundle_index]
+            for k, v in demand.items():
+                avail[k] = avail.get(k, 0.0) - v
+
     def free_to_bundle(self, pg_id: PlacementGroupID, bundle_index: int,
                        demand: ResourceRequest) -> None:
         with self._lock:

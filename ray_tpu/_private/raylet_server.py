@@ -68,6 +68,7 @@ class RayletServer:
         self.labels = dict(labels or {})
         self.shm_store = ShmStore(
             session, object_store_memory or cfg.object_store_memory_bytes,
+            spill_dir=cfg.object_store_fallback_directory or None,
             spill_threshold=cfg.object_spilling_threshold)
         self._functions: Dict[bytes, bytes] = {}
         self._peers = PeerClients()
@@ -85,6 +86,11 @@ class RayletServer:
         self._running: Dict[bytes, BaseWorker] = {}   # task_id -> worker
         self._actor_workers: Dict[bytes, BaseWorker] = {}
         self._creation_tasks: Dict[bytes, bytes] = {}  # actor_id -> task_id
+        # Authoritative local usage: what running tasks and resident
+        # actors nominally demand — the heartbeat reports total minus
+        # this (reference: LocalResourceManager's available view).
+        self._running_demand: Dict[bytes, Dict[str, float]] = {}
+        self._actor_demand: Dict[bytes, Dict[str, float]] = {}
         self._wake = threading.Event()
         self._shutdown = threading.Event()
         self.num_pulled = 0   # objects fetched from peers (transfer stat)
@@ -161,6 +167,7 @@ class RayletServer:
                            actor_id: bytes) -> None:
         with self._lock:
             worker = self._actor_workers.pop(actor_id, None)
+            self._actor_demand.pop(actor_id, None)
         if worker is not None:
             try:
                 worker.send(("shutdown",))
@@ -240,6 +247,11 @@ class RayletServer:
                 worker, fid, lambda: self._functions[fid])
             with self._lock:
                 self._running[payload["task_id"]] = worker
+                if payload["type"] != "exec_actor":
+                    # actor METHOD calls ride the actor's standing
+                    # allocation; exec/create_actor consume capacity
+                    self._running_demand[payload["task_id"]] = dict(
+                        payload.get("resources") or {})
                 if payload["type"] == "create_actor":
                     self._creation_tasks[payload["actor_id"]] = \
                         payload["task_id"]
@@ -342,6 +354,7 @@ class RayletServer:
             _, task_id, results, err_blob = reply
             with self._lock:
                 self._running.pop(task_id, None)
+                self._running_demand.pop(task_id, None)
             if not worker.is_actor_worker:
                 self.worker_pool.push_worker(worker)
             # Seal big results into the node store; ship locations.
@@ -364,11 +377,17 @@ class RayletServer:
             _, actor_id, err_blob = reply
             with self._lock:
                 tid = self._creation_tasks.pop(actor_id, None)
+                demand = {}
                 if tid is not None:
                     self._running.pop(tid, None)
+                    # the creation demand becomes the actor's standing
+                    # allocation for its lifetime
+                    demand = self._running_demand.pop(tid, {})
             if err_blob is None:
                 with self._lock:
                     self._actor_workers[actor_id] = worker
+                    if demand:
+                        self._actor_demand[actor_id] = demand
             else:
                 self.worker_pool.remove_worker(worker)
                 try:
@@ -388,10 +407,12 @@ class RayletServer:
                 if w is worker:
                     dead_tasks.append(tid)
                     self._running.pop(tid)
+                    self._running_demand.pop(tid, None)
             for aid, w in list(self._actor_workers.items()):
                 if w is worker:
                     dead_actors.append(aid)
                     self._actor_workers.pop(aid)
+                    self._actor_demand.pop(aid, None)
         for tid in dead_tasks:
             self._push_owner("task_done", {
                 "task_id": tid, "results": [], "error_blob": None,
@@ -402,16 +423,26 @@ class RayletServer:
 
     # -- gcs heartbeat -------------------------------------------------
 
+    def available_resources(self) -> Dict[str, float]:
+        """Actual free capacity: total minus what running tasks and
+        resident actors nominally demand (the reference raylet's
+        LocalResourceManager view)."""
+        avail = dict(self.resources_total)
+        with self._lock:
+            demands = list(self._running_demand.values()) + list(
+                self._actor_demand.values())
+        for demand in demands:
+            for k, v in demand.items():
+                avail[k] = avail.get(k, 0.0) - v
+        return {k: max(0.0, v) for k, v in avail.items()}
+
     def _heartbeat_loop(self) -> None:
         cfg = get_config()
         period = cfg.health_check_period_ms / 1000.0
         while not self._shutdown.wait(period):
             try:
-                # Report free capacity: total minus what running tasks
-                # nominally demand (the owner keeps the authoritative
-                # allocation ledger; this feeds observers/autoscaling).
                 self.gcs.report_resources(self.node_id,
-                                          dict(self.resources_total))
+                                          self.available_resources())
             except Exception:
                 pass
 
@@ -441,6 +472,7 @@ class RayletServer:
                 "running": len(self._running),
                 "actors": len(self._actor_workers),
                 "num_pulled": self.num_pulled,
+                "available": self.available_resources(),
                 "store": self.shm_store.stats(),
                 "workers": self.worker_pool.stats(),
             }

@@ -9,16 +9,30 @@ multi-host over DCN. Payloads are pickled tuples (the data plane's bulk
 bytes ride the same frames; zero-copy within a host stays on the shm
 plane, this layer is the *transfer* path between stores).
 
-Frame: 8-byte big-endian length + pickle. Messages:
+Frame: 4-byte magic+version ("RTP" + version byte) + 8-byte big-endian
+length + pickle. A frame whose magic does not match is a foreign or
+stale-version peer: the receiver answers with a ("hello_err", reason)
+frame and closes. Messages:
+  ("hello", version, token)         client -> server, FIRST frame
+  ("hello_ok",) / ("hello_err", r)  server -> client, handshake reply
   ("call",  req_id, method, args)   client -> server
   ("reply", req_id, ok, payload)    server -> client
   ("oneway", method, args)          client -> server, no reply
   ("push",  topic, payload)         server -> client, no reply
+
+Trust model (see ARCHITECTURE.md): payloads are pickles, so anyone who
+can complete the handshake can execute code in the receiving process.
+Connections are gated by a per-session secret token (random, written to
+the session dir, inherited by child processes via RTPU_SESSION_TOKEN);
+possession of the token == full cluster access. This matches the
+reference's posture, where any process that can reach the raylet/GCS
+ports participates in the cluster.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import pickle
 import queue
 import socket
@@ -30,13 +44,71 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
-_LEN = struct.Struct(">Q")
+PROTOCOL_VERSION = 1
+_MAGIC = b"RTP" + bytes([PROTOCOL_VERSION])
+_HDR = struct.Struct(">4sQ")
+
+_TOKEN_ENV = "RTPU_SESSION_TOKEN"
+_token_lock = threading.Lock()
+_session_token: Optional[str] = None
+
+
+def set_session_token(token: Optional[str]) -> None:
+    """Install the session secret for this process and its children
+    (exported via RTPU_SESSION_TOKEN so spawned daemons inherit it)."""
+    global _session_token
+    with _token_lock:
+        _session_token = token
+        if token:
+            os.environ[_TOKEN_ENV] = token
+        else:
+            os.environ.pop(_TOKEN_ENV, None)
+
+
+def get_session_token() -> str:
+    with _token_lock:
+        if _session_token is not None:
+            return _session_token
+    return os.environ.get(_TOKEN_ENV, "")
+
+
+def ensure_session_token(session: str) -> str:
+    """Mint the process's session token if absent and persist it 0600
+    into the session dir for same-host tooling. The file is created
+    with O_EXCL-style safety (never follow a pre-existing file or
+    symlink planted in the world-writable /tmp)."""
+    if not get_session_token():
+        set_session_token(os.urandom(16).hex())
+    token = get_session_token()
+    d = os.path.join("/tmp", f"rtpu_{session}")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, "session_token")
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL
+                     | getattr(os, "O_NOFOLLOW", 0), 0o600)
+    except FileExistsError:
+        st = os.lstat(path)
+        if not (st.st_uid == os.getuid() and os.path.isfile(path)
+                and not os.path.islink(path)):
+            raise RuntimeError(
+                f"refusing to write session token: {path} exists and is "
+                f"not a regular file owned by this user")
+        fd = os.open(path, os.O_WRONLY | os.O_TRUNC
+                     | getattr(os, "O_NOFOLLOW", 0))
+    with os.fdopen(fd, "w") as f:
+        f.write(token)
+    return token
+
+
+class ProtocolError(ConnectionError):
+    """Peer speaks a different protocol version or failed the token
+    handshake."""
 
 
 def _send_frame(sock: socket.socket, obj, lock: Optional[threading.Lock]
                 ) -> None:
     data = pickle.dumps(obj, protocol=5)
-    frame = _LEN.pack(len(data)) + data
+    frame = _HDR.pack(_MAGIC, len(data)) + data
     if lock is not None:
         with lock:
             sock.sendall(frame)
@@ -56,7 +128,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_frame(sock: socket.socket):
-    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    magic, length = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if magic != _MAGIC:
+        if magic[:3] == _MAGIC[:3]:
+            raise ProtocolError(
+                f"peer protocol version {magic[3]} != {PROTOCOL_VERSION}")
+        raise ProtocolError(f"bad frame magic {magic!r}")
     return pickle.loads(_recv_exact(sock, length))
 
 
@@ -90,12 +167,14 @@ class RpcServer:
     ``fn(ctx, *args)``; exceptions flow back to the caller as RpcError.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[str] = None):
         self._handlers: Dict[str, Callable] = {}
         self._disconnect_cb: Optional[Callable[[ConnectionContext], None]] \
             = None
         self._live_lock = threading.Lock()
         self._live: set = set()
+        self._token = token
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -103,6 +182,8 @@ class RpcServer:
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 ctx = ConnectionContext(sock, self.client_address)
+                if not outer._handshake(sock):
+                    return
                 with outer._live_lock:
                     outer._live.add(ctx)
                 try:
@@ -132,6 +213,44 @@ class RpcServer:
             daemon=True, name=f"rtpu-rpc-{self.address[1]}")
         self._thread.start()
 
+    def _handshake(self, sock: socket.socket) -> bool:
+        """First frame on every connection must be a matching hello.
+        Refusals are explicit (hello_err + close), never silent. The
+        handshake runs under a deadline so a silent peer cannot pin a
+        handler thread and fd forever."""
+        def refuse(reason: str) -> bool:
+            try:
+                _send_frame(sock, ("hello_err", reason), None)
+            except OSError:
+                pass
+            return False
+
+        try:
+            sock.settimeout(10.0)
+            msg = _recv_frame(sock)
+            sock.settimeout(None)
+        except ProtocolError as e:
+            return refuse(str(e))
+        except (ConnectionError, OSError, EOFError):
+            return False
+        if not (isinstance(msg, tuple) and len(msg) == 3
+                and msg[0] == "hello"):
+            return refuse("expected hello handshake frame")
+        _, version, token = msg
+        if version != PROTOCOL_VERSION:
+            return refuse(f"protocol version mismatch: client speaks "
+                          f"{version}, server speaks {PROTOCOL_VERSION}")
+        expected = self._token if self._token is not None \
+            else get_session_token()
+        if expected and token != expected:
+            return refuse("session token mismatch: connection refused "
+                          "(pass the session's RTPU_SESSION_TOKEN)")
+        try:
+            _send_frame(sock, ("hello_ok",), None)
+        except OSError:
+            return False
+        return True
+
     def register(self, name: str, fn: Callable) -> None:
         self._handlers[name] = fn
 
@@ -152,7 +271,17 @@ class RpcServer:
                 except Exception as e:  # noqa: BLE001 - ships to caller
                     logger.debug("handler %s raised", method, exc_info=True)
                     reply = ("reply", req_id, False, e)
-            _send_frame(ctx._sock, reply, ctx._send_lock)
+            try:
+                _send_frame(ctx._sock, reply, ctx._send_lock)
+            except OSError:
+                raise      # socket is gone; connection teardown handles it
+            except Exception as e:  # unpicklable result or exception
+                logger.exception("reply to %s not serializable", method)
+                _send_frame(ctx._sock,
+                            ("reply", req_id, False,
+                             RpcError(f"handler {method!r} returned/raised "
+                                      f"an unserializable value: {e!r}")),
+                            ctx._send_lock)
         elif kind == "oneway":
             _, method, args = msg
             fn = self._handlers.get(method)
@@ -193,14 +322,34 @@ class RpcClient:
     def __init__(self, address: Tuple[str, int],
                  on_push: Optional[Callable[[str, Any], None]] = None,
                  connect_timeout: float = 10.0,
-                 on_close: Optional[Callable[[], None]] = None):
+                 on_close: Optional[Callable[[], None]] = None,
+                 token: Optional[str] = None):
         self.address = tuple(address)
         self._on_push = on_push
         self._on_close = on_close
         self._sock = socket.create_connection(self.address,
                                               timeout=connect_timeout)
-        self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Version + token handshake before anything else rides the wire.
+        _send_frame(self._sock,
+                    ("hello", PROTOCOL_VERSION,
+                     token if token is not None else get_session_token()),
+                    None)
+        try:
+            hello = _recv_frame(self._sock)
+        except (ConnectionError, OSError, EOFError) as e:
+            self._sock.close()
+            if isinstance(e, ProtocolError):
+                raise
+            raise ProtocolError(
+                f"server at {self.address} closed during handshake "
+                f"({e})") from e
+        if hello[0] != "hello_ok":
+            reason = hello[1] if len(hello) > 1 else "refused"
+            self._sock.close()
+            raise ProtocolError(
+                f"server at {self.address} refused connection: {reason}")
+        self._sock.settimeout(None)
         self._send_lock = threading.Lock()
         self._pending: Dict[int, queue.Queue] = {}
         self._pending_lock = threading.Lock()

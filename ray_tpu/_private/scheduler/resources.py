@@ -85,6 +85,8 @@ class ClusterResourceManager:
         # Policies use it to update their dense matrices row-wise instead
         # of rebuilding O(nodes) state per scheduling batch.
         self._log: deque = deque(maxlen=self._LOG_CAP)
+        # Active heartbeat-report corrections per node (apply_report).
+        self._report_corrections: Dict[NodeID, Dict[str, float]] = {}
 
     def add_or_update_node(self, node_id: NodeID,
                            resources: NodeResources) -> None:
@@ -133,6 +135,49 @@ class ClusterResourceManager:
                 node.free(demand)
                 self._version += 1
                 self._log.append((self._version, node_id, False))
+
+    def apply_report(self, node_id: NodeID,
+                     reported: ResourceRequest) -> None:
+        """Reconcile the ledger with a raylet's self-reported
+        availability (reference: ray_syncer resource broadcast). The
+        correction only ever SHRINKS the view — min(ledger, report) —
+        so allocations in flight that the raylet has not yet observed
+        are never double-counted; each heartbeat first undoes the
+        previous correction, so the view recovers as soon as the
+        raylet reports capacity back."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return
+            prev = self._report_corrections.pop(node_id, {})
+            for k, v in prev.items():
+                node.available[k] = min(node.total.get(k, 0.0),
+                                        node.available.get(k, 0.0) + v)
+            corr = {}
+            for k, rep in reported.items():
+                avail = node.available.get(k, 0.0)
+                if rep + _EPS < avail:
+                    corr[k] = avail - rep
+                    node.available[k] = rep
+            if corr:
+                self._report_corrections[node_id] = corr
+            if corr or prev:
+                self._version += 1
+                self._log.append((self._version, node_id, False))
+
+    def reacquire(self, node_id: NodeID, demand: ResourceRequest) -> None:
+        """Take back resources a blocked task released while waiting on
+        get(). Unconditional: the worker already occupies the CPU, so a
+        transient oversubscription here is truthful accounting that
+        corrects as other tasks finish."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return
+            for k, v in demand.items():
+                node.available[k] = node.available.get(k, 0.0) - v
+            self._version += 1
+            self._log.append((self._version, node_id, False))
 
     def changes_since(self, version: int
                       ) -> Optional[Tuple[Set[NodeID], bool]]:

@@ -30,6 +30,23 @@ class TaskRecord:
     # reconstruction by the task's max_retries, independent of the
     # failure-retry budget: ``object_recovery_manager.cc``).
     reconstructions_left: int = 0
+    # Argument pins are taken once at submission and must release
+    # exactly once, even when lineage reconstruction re-runs a task
+    # that already completed.
+    args_released: bool = False
+
+
+def _contained_item(c):
+    """Normalize a wire contained-ref item. Plain bytes = driver-owned
+    (classic containment pinning); a (bytes, owner_addr) pair is a
+    worker-owned ref whose borrow the sender pre-registered — adopt a
+    ref object so the borrow releases when the container frees."""
+    if isinstance(c, tuple) and len(c) == 2 and c[1] is not None:
+        from ray_tpu._private.object_ref import adopt_preregistered_ref
+        return adopt_preregistered_ref(c[0], tuple(c[1]))
+    if isinstance(c, tuple):
+        return ObjectID(c[0])
+    return ObjectID(c)
 
 
 class Entry:
@@ -56,13 +73,15 @@ class TaskManager:
     def __init__(self,
                  store_result: Callable[[ObjectID, Entry], None],
                  resubmit: Callable[[TaskSpec], None],
-                 on_task_arg_release: Callable[[ObjectID], None]):
+                 on_task_arg_release: Callable[[ObjectID], None],
+                 on_owned_arg_release: Optional[Callable] = None):
         self._lock = threading.RLock()
         self._tasks: Dict[TaskID, TaskRecord] = {}
         self._lineage: Dict[ObjectID, TaskID] = {}
         self._store_result = store_result
         self._resubmit = resubmit
         self._release_arg = on_task_arg_release
+        self._release_owned = on_owned_arg_release
         self.num_finished = 0
         self.num_failed = 0
         self.num_retries = 0
@@ -104,13 +123,13 @@ class TaskManager:
             if error_blob is None and system_error is None:
                 rec.status = "finished"
                 self.num_finished += 1
-                self._release_args(rec.spec)
+                self._release_args(rec)
                 kind_map = {"inline": "blob", "shm": "shm",
                             "remote": "remote"}
                 for oid_b, kind, data, contained in results:
                     entry = Entry(
                         kind_map[kind], data,
-                        tuple(ObjectID(c) for c in contained))
+                        tuple(_contained_item(c) for c in contained))
                     self._store_result(ObjectID(oid_b), entry)
                 return
             # failure path
@@ -127,7 +146,7 @@ class TaskManager:
                 return
             rec.status = "failed"
             self.num_failed += 1
-            self._release_args(rec.spec)
+            self._release_args(rec)
             if error_blob is None:
                 from ray_tpu.exceptions import RayTpuError
                 if isinstance(system_error, RayTpuError):
@@ -153,9 +172,15 @@ class TaskManager:
         except Exception:
             return False
 
-    def _release_args(self, spec: TaskSpec) -> None:
-        for oid in spec.dependencies():
+    def _release_args(self, rec: TaskRecord) -> None:
+        if rec.args_released:
+            return
+        rec.args_released = True
+        for oid in rec.spec.dependencies():
             self._release_arg(oid)
+        if self._release_owned is not None:
+            for oid, owner in rec.spec.owned_args():
+                self._release_owned(owner, oid)
 
     # -- lineage -----------------------------------------------------------
 

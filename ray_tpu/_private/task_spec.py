@@ -45,10 +45,19 @@ class TaskArg:
     object_id: Optional[ObjectID] = None        # by-reference arg
     inline_blob: Optional[bytes] = None         # serialized small value
     is_inline_plain: bool = False               # blob is raw pickle of value
+    # Worker-owned ref (decentralized ownership): the executing worker
+    # resolves the bytes straight from this owner core port; the object
+    # never enters the driver's stores.
+    owner_addr: Optional[Tuple[str, int]] = None
 
     @staticmethod
     def by_ref(object_id: ObjectID) -> "TaskArg":
         return TaskArg(object_id=object_id)
+
+    @staticmethod
+    def by_owned_ref(object_id: ObjectID,
+                     owner_addr: Tuple[str, int]) -> "TaskArg":
+        return TaskArg(object_id=object_id, owner_addr=tuple(owner_addr))
 
     @staticmethod
     def by_value(blob: bytes) -> "TaskArg":
@@ -76,8 +85,8 @@ class TaskOptions:
     placement_group_bundle_index: int = -1
     runtime_env: Optional[dict] = None
     name: Optional[str] = None
-    # actors only:
-    max_restarts: int = 0
+    # actors only (None -> config default actor_max_restarts):
+    max_restarts: Optional[int] = None
     max_task_retries: int = 0
     max_concurrency: int = 1
     lifetime: Optional[str] = None
@@ -135,7 +144,16 @@ class TaskSpec:
     depth: int = 0
 
     def dependencies(self) -> List[ObjectID]:
-        return [a.object_id for a in self.args if a.object_id is not None]
+        """Driver-store dependencies. Worker-owned args are excluded:
+        they are complete at submission (puts) and resolve owner-direct
+        at execution — the driver's dependency manager never waits on
+        them."""
+        return [a.object_id for a in self.args
+                if a.object_id is not None and a.owner_addr is None]
+
+    def owned_args(self) -> List[Tuple[ObjectID, Tuple[str, int]]]:
+        return [(a.object_id, a.owner_addr) for a in self.args
+                if a.owner_addr is not None]
 
     def repr_name(self) -> str:
         return self.name or self.function.repr_name()

@@ -118,11 +118,19 @@ class Worker:
         self._put_index = 0
         self._counter_lock = threading.Lock()
 
+        # Session secret gating every RPC connection (rpc.py handshake).
+        # Heads mint one; joiners must arrive with the head's token in
+        # RTPU_SESSION_TOKEN (printed by `ray_tpu start --head`).
+        from ray_tpu._private import rpc as _rpc
+        if self._join_address is None:
+            _rpc.ensure_session_token(self.session)
+
         self.serde = serialization.get_context()
         self.memory_store = MemoryStore()
         self.shm_store = ShmStore(
             self.session,
             object_store_memory or cfg.object_store_memory_bytes,
+            spill_dir=cfg.object_store_fallback_directory or None,
             spill_threshold=cfg.object_spilling_threshold)
         from ray_tpu._private.device_object import DeviceStore
         self.device_store = DeviceStore()
@@ -138,7 +146,7 @@ class Worker:
             from ray_tpu._private.gcs_client import GcsClient
             from ray_tpu._private.gcs_server import spawn_gcs_process
             self._gcs_proc, self.gcs_address = spawn_gcs_process(
-                self.session, cfg.serialize())
+                self.session, cfg.serialize(), persist=True)
             self.gcs = GcsClient(self.gcs_address)
         else:
             self.gcs = GcsLite()
@@ -159,10 +167,12 @@ class Worker:
             total.update({k: float(v) for k, v in resources.items()})
         node_res = NodeResources(total=dict(total), available=dict(total))
 
+        from ray_tpu._private import worker_core as _wc
         self.task_manager = TaskManager(
             store_result=self._store_result,
             resubmit=self._resubmit,
-            on_task_arg_release=self.reference_counter.remove_task_argument)
+            on_task_arg_release=self.reference_counter.remove_task_argument,
+            on_owned_arg_release=_wc.release_borrow)
 
         if max_process_workers is None:
             max_process_workers = max(2, min(8, int(num_cpus)))
@@ -191,6 +201,13 @@ class Worker:
         self.gcs.register_node(NodeInfo(
             node_id=self.node_group.head_node_id,
             resources_total=dict(total)))
+
+        # Raylet self-reported availability (RESOURCES channel):
+        # reconcile the scheduler's ledger — a wedged/externally-loaded
+        # raylet's truth overrides the driver's optimistic view within
+        # one heartbeat — and keep the raw reports for the dashboard.
+        self.node_reports: Dict[NodeID, Tuple[float, Dict[str, float]]] = {}
+        self.gcs.publisher.subscribe("RESOURCES", self._on_resource_report)
 
         # per-actor ordered submission queues; _actor_flush_locks
         # serialize pop+send per actor so concurrent flushers (driver
@@ -321,12 +338,32 @@ class Worker:
             except FileNotFoundError:
                 logger.warning("shm segment for %s vanished", oid)
         if entry.contained:
-            self.reference_counter.add_contained(
-                oid, [c if isinstance(c, ObjectID) else ObjectID(c)
-                      for c in entry.contained])
+            driver_children = []
+            for c in entry.contained:
+                if isinstance(c, ObjectID):
+                    driver_children.append(c)
+                elif isinstance(c, ObjectRef):
+                    if c.owner_addr() is None:
+                        driver_children.append(c.id())
+                    # worker-owned child: pinned by the live ref object
+                    # the entry holds (its death releases the borrow)
+                else:
+                    driver_children.append(ObjectID(c))
+            if driver_children:
+                self.reference_counter.add_contained(oid, driver_children)
         self.memory_store.put(oid, entry)
         self.node_group.on_object_available(oid)
         self._flush_actor_queues()
+
+    def _on_resource_report(self, message) -> None:
+        try:
+            node_id, available = message
+            self.node_reports[node_id] = (time.time(), dict(available))
+            if node_id != self.node_group.head_node_id:
+                self.node_group.cluster_resources.apply_report(
+                    node_id, available)
+        except Exception:
+            logger.exception("resource report handling failed")
 
     def _on_ref_zero(self, oid: ObjectID) -> None:
         self.memory_store.free(oid)
@@ -337,8 +374,12 @@ class Worker:
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
+        owned = self._resolve_owned(refs, deadline)
         out: List[Any] = []
-        for ref in refs:
+        for i, ref in enumerate(refs):
+            if ref.owner_addr() is not None:
+                out.append(owned[i])
+                continue
             while True:
                 remaining = None
                 if deadline is not None:
@@ -361,6 +402,32 @@ class Worker:
                             f"object {ref.id()} was lost and cannot be "
                             "reconstructed (no lineage retained or "
                             "reconstruction budget exhausted)") from None
+        return out
+
+    def _resolve_owned(self, refs: Sequence[ObjectRef],
+                       deadline: Optional[float]) -> Dict[int, Any]:
+        """Resolve the worker-owned refs in ``refs`` (by index) — ONE
+        batched round trip per owner, shared deadline across owners:
+        the decentralized-ownership data path."""
+        from collections import defaultdict
+        from ray_tpu._private import worker_core
+        by_owner: Dict[tuple, List[int]] = defaultdict(list)
+        for i, ref in enumerate(refs):
+            if ref.owner_addr() is not None:
+                by_owner[ref.owner_addr()].append(i)
+        out: Dict[int, Any] = {}
+        for owner, idxs in by_owner.items():
+            remaining = None
+            if deadline is not None:
+                remaining = max(0.0, deadline - time.monotonic())
+            try:
+                values = worker_core.fetch_values_from_owner(
+                    owner, [refs[i].id() for i in idxs], remaining)
+            except TimeoutError:
+                raise GetTimeoutError(
+                    "get() timed out waiting for worker-owned "
+                    f"objects at {owner}") from None
+            out.update(zip(idxs, values))
         return out
 
     def _entry_value(self, oid: ObjectID, entry: Entry) -> Any:
@@ -470,6 +537,10 @@ class Worker:
             if d[0] == "v":
                 v, _ = self.serde.deserialize_from_blob(memoryview(d[1]))
                 vals.append(v)
+            elif d[0] == "ro":
+                vals.append(ObjectRef(ObjectID(d[1]),
+                                      owner_addr=tuple(d[2]),
+                                      _count=False))
             else:
                 vals.append(ObjectRef(ObjectID(d[1]), _count=False))
         if kwargs_keys:
@@ -521,6 +592,13 @@ class Worker:
         for d in arg_descs:
             if d[0] == "v":
                 spec_args.append(TaskArg.by_value(d[1]))
+            elif d[0] == "ro":
+                # Worker-owned arg: pin at the owner for the task's
+                # lifetime (released by the owned-arg release hook).
+                from ray_tpu._private import worker_core
+                oid, owner = ObjectID(d[1]), tuple(d[2])
+                worker_core.register_borrow(owner, oid)
+                spec_args.append(TaskArg.by_owned_ref(oid, owner))
             else:
                 oid = ObjectID(d[1])
                 spec_args.append(TaskArg.by_ref(oid))
@@ -608,11 +686,13 @@ class Worker:
         return [oid.binary() for oid in ready]
 
     def _release_blocked_parent(self, task_id_b: bytes):
-        """A parent task blocking on get() releases its resource
-        allocation and lends its node one extra worker slot, so child
-        tasks can run even at pool capacity (the reference's
-        CPU-release-while-blocked deadlock avoidance). Returns the
-        restore callback."""
+        """A parent task blocking on get() releases its CPU allocation
+        and lends its node one extra worker slot, so child tasks can run
+        even at pool capacity (the reference's CPU-release-while-blocked
+        deadlock avoidance). Only the CPU slice is released: accelerator
+        and custom resources stay held because the blocked task's device
+        memory (HBM) is still occupied. The returned restore callback
+        re-acquires the CPU and retracts the lent slot."""
         if not task_id_b:
             return lambda: None
         ng = self.node_group
@@ -621,18 +701,38 @@ class Worker:
             rt = ng._running.get(tid)
             if rt is None:
                 return lambda: None
-            resources, pg = rt.resources, rt.pg
-            rt.resources, rt.pg = {}, None
-            raylet = ng._raylets.get(rt.node_id)
-            handle = ng._remote_nodes.get(rt.node_id)
-        if resources:
-            ng._free_allocation(rt.node_id, resources, pg)
+            cpu_part = {k: v for k, v in rt.resources.items() if k == "CPU"}
+            rt.resources = {k: v for k, v in rt.resources.items()
+                            if k != "CPU"}
+            pg, node_id = rt.pg, rt.node_id
+            raylet = ng._raylets.get(node_id)
+            handle = ng._remote_nodes.get(node_id)
+        if cpu_part:
+            ng._free_allocation(node_id, cpu_part, pg)
+
+        def _reacquire():
+            if not cpu_part:
+                return
+            with ng._lock:
+                rt2 = ng._running.get(tid)
+                if rt2 is None:
+                    # Task completed/crashed while blocked: the
+                    # completion path already freed its (CPU-less)
+                    # allocation — debiting now would leak capacity.
+                    return
+                merged = dict(rt2.resources)
+                for k, v in cpu_part.items():
+                    merged[k] = merged.get(k, 0.0) + v
+                rt2.resources = merged
+            ng.reacquire_allocation(node_id, cpu_part, pg)
+
         if raylet is not None:
             with ng._lock:
                 raylet.worker_pool._max_process += 1
             ng._wake.set()
 
             def release():
+                _reacquire()
                 with ng._lock:
                     raylet.worker_pool._max_process -= 1
             return release
@@ -643,12 +743,13 @@ class Worker:
                 pass
 
             def release():
+                _reacquire()
                 try:
                     handle.client.oneway("adjust_pool", -1)
                 except Exception:
                     pass
             return release
-        return lambda: None
+        return _reacquire
 
     # -- lineage reconstruction ----------------------------------------
 
@@ -702,8 +803,28 @@ class Worker:
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None
              ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
-        ids = [r.id() for r in refs]
-        ready_ids, _ = self.memory_store.wait(ids, num_returns, timeout)
+        owned_ready: set = set()
+        driver_ids = []
+        for r in refs:
+            owner = r.owner_addr()
+            if owner is None:
+                driver_ids.append(r.id())
+                continue
+            # Worker-owned: ready iff the owner holds it. A dead owner
+            # also counts as ready — get() will raise OwnerDiedError,
+            # and the reference counts error-resolved refs as ready.
+            from ray_tpu._private import worker_core
+            try:
+                if worker_core.owner_contains(owner, r.id()):
+                    owned_ready.add(r.id())
+            except Exception:
+                owned_ready.add(r.id())
+        need = max(0, num_returns - len(owned_ready))
+        ready_ids = set()
+        if driver_ids:
+            got, _ = self.memory_store.wait(driver_ids, need, timeout)
+            ready_ids = set(got)
+        ready_ids |= owned_ready
         ready, not_ready = [], []
         for r in refs:
             (ready if r.id() in ready_ids and len(ready) < num_returns
@@ -719,6 +840,16 @@ class Worker:
         kwargs_keys = list(kwargs.keys())
         for value in list(args) + [kwargs[k] for k in kwargs_keys]:
             if isinstance(value, ObjectRef):
+                if value.owner_addr() is not None:
+                    # Worker-owned ref: pin at the OWNER for the task's
+                    # lifetime (released on terminal completion via
+                    # TaskManager's owned-arg release hook).
+                    from ray_tpu._private import worker_core
+                    worker_core.register_borrow(value.owner_addr(),
+                                                value.id())
+                    spec_args.append(TaskArg.by_owned_ref(
+                        value.id(), value.owner_addr()))
+                    continue
                 spec_args.append(TaskArg.by_ref(value.id()))
                 self.reference_counter.add_task_argument(value.id())
                 continue
@@ -925,6 +1056,9 @@ class Worker:
         spec_args: List[TaskArg] = []
         kwargs_keys = self.build_args(args, kwargs, spec_args)
         demand = options.resource_demand(default_cpus=1.0)
+        max_restarts = (options.max_restarts
+                        if options.max_restarts is not None
+                        else get_config().actor_max_restarts)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -936,7 +1070,7 @@ class Worker:
             resources=demand,
             max_retries=0,
             actor_creation_id=actor_id,
-            max_restarts=options.max_restarts,
+            max_restarts=max_restarts,
             max_task_retries=options.max_task_retries,
             max_concurrency=max(1, options.max_concurrency),
             scheduling_strategy=options.scheduling_strategy,
@@ -948,14 +1082,14 @@ class Worker:
         info = ActorInfo(
             actor_id=actor_id, name=options.name,
             namespace=options.namespace or "default",
-            max_restarts=options.max_restarts,
+            max_restarts=max_restarts,
             creation_spec=spec, class_name=class_name)
         self.gcs.register_actor(info)
         with self._actor_lock:
             self._actor_queues[actor_id] = deque()
             self._actor_seq[actor_id] = 0
             self._actor_specs[actor_id] = spec
-            self._actor_restarts[actor_id] = options.max_restarts
+            self._actor_restarts[actor_id] = max_restarts
         self.task_manager.add_pending_task(spec)
         self.node_group.submit_task(spec)
         return actor_id
@@ -1074,6 +1208,10 @@ class Worker:
             if arg.object_id is None:
                 arg_descs.append(("v", arg.inline_blob))
                 continue
+            if arg.owner_addr is not None:
+                arg_descs.append(("owned", arg.object_id.binary(),
+                                  tuple(arg.owner_addr)))
+                continue
             try:
                 entry: Entry = self.memory_store.get(arg.object_id, timeout=0)
             except TimeoutError:
@@ -1167,6 +1305,13 @@ class Worker:
             return
         self._shutdown = True
         self.reference_counter.freeze()
+        from ray_tpu._private import worker_core as _wc
+        core = _wc.try_worker_core()
+        if core is not None:
+            # in-process tasks created a driver-hosted worker core:
+            # its objects die with the session (unlink segments)
+            core.shutdown()
+            _wc._core = None
         self.node_group.shutdown()
         self.shm_store.shutdown()
         self.device_store.shutdown()

@@ -137,6 +137,11 @@ class ExecutionEnv:
             blob = self.shm_client.read(segment_name, size)
             value, _refs = self.serde.deserialize_from_blob(blob)
             return value
+        if kind == "owned":  # worker-owned: fetch from the owner direct
+            from ray_tpu._private import worker_core
+            from ray_tpu._private.ids import ObjectID as _OID
+            return worker_core.fetch_value_from_owner(
+                tuple(desc[2]), _OID(desc[1]), timeout=30.0)
         raise ValueError(f"bad arg descriptor {kind!r}")
 
     # -- result storage ----------------------------------------------------
@@ -146,7 +151,8 @@ class ExecutionEnv:
         out = []
         for oid_bytes, value in zip(return_ids, values):
             ser = self.serde.serialize(value)
-            contained = [r.binary() for r in ser.contained_refs]
+            contained = [self._contained_desc(r)
+                         for r in ser.contained_refs]
             size = ser.size_with_header()
             if size <= self.max_inline_bytes:
                 out.append((oid_bytes, "inline", ser.to_bytes(), contained))
@@ -160,6 +166,21 @@ class ExecutionEnv:
                     seg.close()  # driver adopts the segment by name
                 out.append((oid_bytes, "shm", (name, size), contained))
         return out
+
+    @staticmethod
+    def _contained_desc(r):
+        """Wire item for a ref captured inside a result value. For a
+        worker-owned ref, register a borrow with the owner ON BEHALF of
+        the recipient before the message ships (borrow handed off with
+        the message — otherwise the owner could free the object in the
+        window between this task ending and the recipient pinning it)."""
+        owner = getattr(r, "_owner_addr", None)
+        if owner is None:
+            return r.binary()
+        from ray_tpu._private import worker_core
+        oid = r.id() if hasattr(r, "id") else r
+        worker_core.register_borrow(owner, oid)
+        return (r.binary(), tuple(owner))
 
     # -- task execution ----------------------------------------------------
 
@@ -269,6 +290,8 @@ def worker_main(conn, session: str, max_inline_bytes: int,
     if env_vars:
         os.environ.update(env_vars)
 
+    from ray_tpu._private import worker_core
+    worker_core.configure(session, max_inline_bytes)
     env = ExecutionEnv(session, max_inline_bytes)
     send_lock = threading.Lock()
 
@@ -312,6 +335,11 @@ def worker_main(conn, session: str, max_inline_bytes: int,
         for pool in pools.values():
             pool.shutdown(wait=False)
         env.shm_client.close()
+        core = worker_core.try_worker_core()
+        if core is not None:
+            # Owner death: objects this process owns die with it
+            # (ownership is not replicated) — unlink their segments.
+            core.shutdown()
         try:
             conn.close()
         except Exception:

@@ -57,9 +57,13 @@ class Cluster:
         from ray_tpu._private.gcs_client import GcsClient
         from ray_tpu._private.gcs_server import spawn_gcs_process
         self._gcs_proc, self._gcs_addr = spawn_gcs_process(
-            self._worker.session, get_config().serialize())
+            self._worker.session, get_config().serialize(), persist=True)
         self._gcs_client = GcsClient(self._gcs_addr)
         self._gcs_client.publisher.subscribe("NODE", self._on_node_event)
+        # Route raylet heartbeats into the driver (the driver's own gcs
+        # is in-proc here; this client is its channel to the GCS proc).
+        self._gcs_client.publisher.subscribe(
+            "RESOURCES", self._worker._on_resource_report)
 
     @property
     def gcs_address(self):

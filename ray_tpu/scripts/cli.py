@@ -24,17 +24,24 @@ import sys
 
 
 def _cmd_start(args) -> int:
+    from ray_tpu._private import rpc as _rpc
     from ray_tpu._private.config import get_config
 
     session = args.session
+    if args.token:
+        _rpc.set_session_token(args.token)
     if args.head:
         from ray_tpu._private.gcs_server import spawn_gcs_process
-        proc, addr = spawn_gcs_process(session, get_config().serialize())
+        token = _rpc.ensure_session_token(session)
+        proc, addr = spawn_gcs_process(session, get_config().serialize(),
+                                       persist=True)
         print(f"GCS started (pid {proc.pid}) at {addr[0]}:{addr[1]}")
-        print(f"Join a driver with: ray_tpu.init("
-              f"address=\"{addr[0]}:{addr[1]}\")")
+        print(f"Session token (required by joiners): {token}")
+        print(f"Join a driver with: RTPU_SESSION_TOKEN={token} and "
+              f"ray_tpu.init(address=\"{addr[0]}:{addr[1]}\")")
         print(f"Add a node with: python -m ray_tpu start "
-              f"--address {addr[0]}:{addr[1]} --num-cpus 4")
+              f"--address {addr[0]}:{addr[1]} --token {token} "
+              f"--num-cpus 4")
         return 0
     if not args.address:
         print("start needs --head or --address HOST:PORT",
@@ -61,6 +68,9 @@ def _cmd_start(args) -> int:
 
 def _cmd_status(args) -> int:
     from ray_tpu._private.gcs_client import GcsClient
+    if getattr(args, "token", ""):
+        from ray_tpu._private import rpc as _rpc
+        _rpc.set_session_token(args.token)
     host, port = args.address.rsplit(":", 1)
     client = GcsClient((host, int(port)))
     try:
@@ -187,10 +197,13 @@ def main(argv=None) -> int:
     sp.add_argument("--resources", default="",
                     help="extra resources as JSON")
     sp.add_argument("--max-workers", type=int, default=2)
+    sp.add_argument("--token", default="",
+                    help="session token (joiners: as printed by --head)")
     sp.set_defaults(fn=_cmd_start)
 
     sp = sub.add_parser("status", help="cluster state from the GCS")
     sp.add_argument("--address", required=True)
+    sp.add_argument("--token", default="")
     sp.set_defaults(fn=_cmd_status)
 
     sp = sub.add_parser("stop", help="terminate cluster processes")

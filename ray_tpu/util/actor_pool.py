@@ -38,14 +38,23 @@ class ActorPool:
         return bool(self._idle)
 
     def get_next(self, timeout: Optional[float] = None) -> Any:
-        """Next result in submission order."""
+        """Next result in submission order.
+
+        A timeout leaves the pending-slot bookkeeping intact (the call can
+        be retried); the actor is returned to the idle pool *before* the
+        result is fetched so a task that raised cannot strand it.
+        """
         if self._next_return_index >= self._next_task_index:
             raise StopIteration("no pending results")
-        ref = self._index_to_future.pop(self._next_return_index)
+        ref = self._index_to_future[self._next_return_index]
+        if timeout is not None:
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
+            if not ready:
+                raise TimeoutError("get_next timed out")
+        del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
-        value = ray_tpu.get(ref, timeout=timeout)
         self._idle.append(self._future_to_actor.pop(ref))
-        return value
+        return ray_tpu.get(ref)
 
     def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
         """Next result to complete, any order."""

@@ -70,6 +70,58 @@ def test_rpc_large_payload_roundtrip():
     server.shutdown()
 
 
+def test_rpc_token_and_version_refusals():
+    """Wrong-token and stale-version clients both get explicit,
+    named refusals — never a hang or a pickle error."""
+    import pickle
+    import socket
+
+    from ray_tpu._private import rpc as rpc_mod
+    from ray_tpu._private.rpc import ProtocolError
+
+    server = RpcServer(token="sekrit")
+    server.register("ping", lambda ctx: "pong")
+    try:
+        good = RpcClient(server.address, token="sekrit")
+        assert good.call("ping") == "pong"
+        good.close()
+
+        with pytest.raises(ProtocolError, match="token"):
+            RpcClient(server.address, token="wrong")
+        with pytest.raises(ProtocolError, match="token"):
+            RpcClient(server.address, token="")   # token-less client
+
+        # Stale-version peer: frame carries an older magic version byte.
+        sock = socket.create_connection(server.address, timeout=5)
+        data = pickle.dumps(("hello", 0, "sekrit"), protocol=5)
+        sock.sendall(rpc_mod._HDR.pack(b"RTP\x00", len(data)) + data)
+        magic, length = rpc_mod._HDR.unpack(
+            rpc_mod._recv_exact(sock, rpc_mod._HDR.size))
+        assert magic == rpc_mod._MAGIC
+        reply = pickle.loads(rpc_mod._recv_exact(sock, length))
+        assert reply[0] == "hello_err"
+        assert "version" in reply[1]
+        sock.close()
+    finally:
+        server.shutdown()
+
+
+def test_rpc_unpicklable_reply_keeps_connection():
+    """A handler returning an unpicklable value must error just that
+    call, not tear down the socket with every in-flight call on it."""
+    server = RpcServer()
+    server.register("bad", lambda ctx: lambda: None)   # lambdas: unpicklable
+    server.register("ping", lambda ctx: "pong")
+    client = RpcClient(server.address)
+    try:
+        with pytest.raises(RpcError, match="unserializable"):
+            client.call("bad")
+        assert client.call("ping") == "pong"   # connection survived
+    finally:
+        client.close()
+        server.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # GCS server process
 
@@ -355,3 +407,73 @@ def test_remote_actor_lifecycle(ray_start_cluster):
     # big actor result stays remote until pulled
     assert ray_tpu.get(c.big.remote()).shape == (BIG,)
     ray_tpu.kill(c)
+
+
+# ---------------------------------------------------------------------------
+# resource heartbeat: truthful availability, consumed by the driver
+
+
+def test_resource_report_reconciles_scheduler_view():
+    """A raylet's self-reported availability corrects the driver's
+    ledger (min-reconciliation) and recovers on the next report."""
+    import ray_tpu as rt
+    rt.init(num_cpus=2)
+    try:
+        from ray_tpu._private.ids import NodeID as NID
+        from ray_tpu._private.scheduler.resources import NodeResources
+        from ray_tpu._private.worker import global_worker
+
+        w = global_worker()
+        cr = w.node_group.cluster_resources
+        nid = NID.from_random()
+        cr.add_or_update_node(nid, NodeResources(
+            total={"CPU": 8.0}, available={"CPU": 8.0}))
+        # wedged raylet: claims only 2 free though the ledger says 8
+        w._on_resource_report((nid, {"CPU": 2.0}))
+        assert cr.get_node(nid).available["CPU"] == 2.0
+        # recovery: full capacity reported again
+        w._on_resource_report((nid, {"CPU": 8.0}))
+        assert cr.get_node(nid).available["CPU"] == 8.0
+        # ledger allocations compose with corrections
+        assert cr.allocate(nid, {"CPU": 4.0})
+        w._on_resource_report((nid, {"CPU": 1.0}))
+        assert cr.get_node(nid).available["CPU"] == 1.0
+        w._on_resource_report((nid, {"CPU": 4.0}))
+        assert cr.get_node(nid).available["CPU"] == 4.0
+        assert w.node_reports[nid][1] == {"CPU": 4.0}
+    finally:
+        rt.shutdown()
+
+
+def test_raylet_heartbeat_reports_real_availability(ray_start_cluster):
+    """A remote raylet's heartbeat reflects what its running tasks
+    consume — not the static totals — and the driver records it."""
+    cluster = ray_start_cluster
+    nid = cluster.add_node(num_cpus=2, resources={"HB": 2}, remote=True)
+    from ray_tpu._private.worker import global_worker
+    w = global_worker()
+
+    @ray_tpu.remote(num_cpus=1, resources={"HB": 1})
+    def busy():
+        time.sleep(6.0)
+        return "done"
+
+    ref = busy.remote()
+    deadline = time.monotonic() + 20
+    seen_busy = False
+    while time.monotonic() < deadline and not seen_busy:
+        report = w.node_reports.get(nid)
+        if report is not None and report[1].get("HB") == 1.0:
+            seen_busy = True
+        time.sleep(0.2)
+    assert seen_busy, f"never saw a busy heartbeat: {w.node_reports.get(nid)}"
+    assert ray_tpu.get(ref, timeout=120) == "done"
+    # after completion, the heartbeat recovers to full capacity
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        report = w.node_reports.get(nid)
+        if report is not None and report[1].get("HB") == 2.0:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail(f"heartbeat did not recover: {w.node_reports.get(nid)}")
