@@ -1,0 +1,133 @@
+"""Compiled actor-DAG pipeline: stage handoffs on pre-arranged channels.
+
+A 3-stage inference pipeline (tokenize → jitted model forward →
+decode) compiled with ``experimental_compile``. Per request the driver
+sends ONE pre-bound payload per stage up front; each stage's output
+travels worker→worker through its owner-core channel (shm on the same
+machine) — the driver only sees the terminal result. Compare with the
+uncompiled chained ``.remote()`` version, which routes every
+intermediate through the driver's queues.
+
+Reference analog: ``python/ray/dag`` compiled graphs with NCCL
+channels [UNVERIFIED — mount empty, SURVEY.md §0]; here the channel
+plane is owner-core shm/TCP and the model stage is a jitted XLA
+program.
+
+    python examples/adag_pipeline.py [--requests 100]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+@ray_tpu.remote
+class Tokenizer:
+    VOCAB = 257
+
+    def encode(self, text: str):
+        ids = np.frombuffer(text.encode(), dtype=np.uint8).astype(np.int32)
+        return ids
+
+
+@ray_tpu.remote
+class Model:
+    """Jitted embedding-sum scorer (stands in for a transformer).
+
+    The default ``dim`` makes the model→decoder activation ~128 KB, so
+    the compiled handoff rides the owner-core shm channel — the
+    uncompiled path copies it twice through driver pipes instead.
+    """
+
+    def __init__(self, vocab: int = 257, dim: int = 32768):
+        import jax
+        import jax.numpy as jnp
+        key = jax.random.PRNGKey(0)
+        self.table = jax.random.normal(key, (vocab, dim))
+
+        def fwd(table, ids):
+            emb = table[ids]
+            return jnp.tanh(emb.sum(axis=0))
+
+        self.fwd = jax.jit(fwd)
+
+    def forward(self, ids):
+        return np.asarray(self.fwd(self.table, ids))
+
+
+@ray_tpu.remote
+class Decoder:
+    def decode(self, logits):
+        return {"argmax": int(np.argmax(logits)),
+                "norm": float(np.linalg.norm(logits))}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=100)
+    args = parser.parse_args()
+
+    # Explicit CPU count: the pipeline stages are IO/dispatch-bound, so
+    # oversubscribing a small host is fine (and a 1-core box would
+    # otherwise fit only one 1-CPU actor).
+    ray_tpu.init(num_cpus=8)
+    tok, model, dec = Tokenizer.remote(), Model.remote(), Decoder.remote()
+    # warm the model actor
+    ray_tpu.get(model.forward.remote(np.zeros(4, dtype=np.int32)))
+
+    with InputNode() as request:
+        dag = dec.decode.bind(model.forward.bind(tok.encode.bind(request)))
+    compiled = dag.experimental_compile()
+    assert compiled.is_fast, "pipeline should use pre-arranged channels"
+
+    texts = [f"request payload number {i}" for i in range(args.requests)]
+
+    # warm both paths (jit shapes, channel connections) before timing
+    ray_tpu.get(compiled.execute(texts[0]))
+    ray_tpu.get(dec.decode.remote(
+        model.forward.remote(tok.encode.remote(texts[0]))))
+
+    # serial: one request at a time (dispatch latency)
+    t0 = time.perf_counter()
+    out_u = [ray_tpu.get(
+        dec.decode.remote(model.forward.remote(tok.encode.remote(t))))
+        for t in texts]
+    uncompiled_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out_c = [ray_tpu.get(compiled.execute(t)) for t in texts]
+    compiled_s = time.perf_counter() - t0
+    assert out_c == out_u
+
+    # pipelined: all requests in flight (driver work per request is
+    # what limits throughput — compiled keeps the driver out of the
+    # stage handoffs)
+    t0 = time.perf_counter()
+    out_u = ray_tpu.get([
+        dec.decode.remote(model.forward.remote(tok.encode.remote(t)))
+        for t in texts])
+    uncompiled_pipe_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    out_c = ray_tpu.get([compiled.execute(t) for t in texts])
+    compiled_pipe_s = time.perf_counter() - t0
+    assert out_c == out_u
+
+    print(json.dumps({
+        "requests": args.requests,
+        "serial_compiled_ms": 1e3 * compiled_s / args.requests,
+        "serial_uncompiled_ms": 1e3 * uncompiled_s / args.requests,
+        "pipelined_compiled_ms": 1e3 * compiled_pipe_s / args.requests,
+        "pipelined_uncompiled_ms": 1e3 * uncompiled_pipe_s / args.requests,
+        "pipelined_speedup": uncompiled_pipe_s / compiled_pipe_s,
+    }))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -273,6 +273,7 @@ def spawn_gcs_process(session: str, config_json: str = "",
             os.path.abspath(__file__))))]
         + env.get("PYTHONPATH", "").split(os.pathsep))
     env["JAX_PLATFORMS"] = "cpu"   # the GCS never touches the TPU
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no chip tunnel in children
     log = open(os.path.join(d, "gcs.log"), "ab")
     cmd = [sys.executable, "-m", "ray_tpu._private.gcs_server",
            "--port-file", port_file, "--config", config_json]

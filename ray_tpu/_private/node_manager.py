@@ -679,6 +679,41 @@ class NodeManagerGroup:
             entry = self._actor_workers.get(actor_id)
             return entry[1] if entry else None
 
+    def worker_core_addr(self, actor_id: ActorID,
+                         timeout: float = 30.0):
+        """Owner-core (host, port) of the process executing this actor —
+        the pre-bound endpoint compiled DAGs use for stage handoffs.
+        Returns None for actors on remote raylet nodes (compiled DAGs
+        fall back to the replay path there)."""
+        from ray_tpu._private.worker_pool import (InProcessWorker,
+                                                  ProcessWorker)
+        with self._lock:
+            entry = self._actor_workers.get(actor_id)
+        if entry is None:
+            return None
+        worker = entry[1]
+        if isinstance(worker, InProcessWorker):
+            # In-process actors share the driver process; their owner
+            # core is this process's singleton.
+            from ray_tpu._private import worker_core
+            return worker_core.get_worker_core().address
+        if not isinstance(worker, ProcessWorker):
+            return None
+        addr = getattr(worker, "core_addr", None)
+        if addr is not None:
+            return addr
+        with self._lock:
+            # Under the lock: two concurrent compiles must share ONE
+            # event or the loser waits on an orphan until timeout.
+            evt = getattr(worker, "_core_addr_evt", None)
+            if evt is None:
+                evt = worker._core_addr_evt = threading.Event()
+        worker.send(("core_addr",))
+        if not evt.wait(timeout):
+            raise TimeoutError(
+                "worker did not report its owner-core address")
+        return worker.core_addr
+
     def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec,
                           payload: dict) -> bool:
         with self._lock:
@@ -1102,6 +1137,13 @@ class NodeManagerGroup:
             _, task_id_b, results = reply
             if self._stream_item_cb is not None:
                 self._stream_item_cb(TaskID(task_id_b), results)
+            return
+        if op == "core_addr":
+            # Reply to a compiled-DAG channel-binding request.
+            worker.core_addr = tuple(reply[1])
+            evt = getattr(worker, "_core_addr_evt", None)
+            if evt is not None:
+                evt.set()
             return
         if op == "done":
             _, task_id_b, results, err_blob = reply

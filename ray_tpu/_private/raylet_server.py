@@ -544,6 +544,7 @@ def spawn_raylet_process(session: str, node_id: NodeID,
             os.path.abspath(__file__))))]
         + env.get("PYTHONPATH", "").split(os.pathsep))
     env["JAX_PLATFORMS"] = "cpu"      # remote raylets never own the TPU
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no chip tunnel in children
     cmd = [sys.executable, "-m", "ray_tpu._private.raylet_server",
            "--session", session, "--node-id", node_id.hex(),
            "--resources", json.dumps(resources_total),

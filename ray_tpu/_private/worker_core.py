@@ -66,6 +66,8 @@ class WorkerCore:
         # Containment: refs captured inside a stored value stay alive
         # (and thus borrowed/pinned) for the container's lifetime.
         self._contained: Dict[ObjectID, tuple] = {}
+        # Inbound compiled-DAG channel values: oid -> [entry, takes_left]
+        self._pushed: Dict[ObjectID, list] = {}
         self._zombies: List[Any] = []   # segments with live local views
         self.server = RpcServer()
         self.address: Tuple[str, int] = self.server.address
@@ -80,6 +82,9 @@ class WorkerCore:
         s.register("owner_contains", self._h_contains)
         s.register("owner_borrow", self._h_borrow)
         s.register("owner_release", self._h_release)
+        s.register("chan_push",
+                   lambda ctx, oid_b, entry, takes:
+                   self.accept_push(ObjectID(oid_b), tuple(entry), takes))
 
     # -- owner-side API (called by user code in THIS process) ----------
 
@@ -95,7 +100,10 @@ class WorkerCore:
             entry = ("blob", ser.to_bytes())
             seg = None
         else:
-            name = f"rtpu_own_{os.getpid()}_{oid.hex()[:24]}"
+            # Full oid in the name: a truncated prefix would be
+            # constant across one owner's puts/channels (it only covers
+            # the task-id prefix) and collide under load.
+            name = f"rtpu_own_{os.getpid()}_{oid.hex()}"
             seg = create_segment(name, size)
             ser.write_into(seg.buf)
             entry = ("shm", name, size)
@@ -113,6 +121,77 @@ class WorkerCore:
     def owns(self, oid: ObjectID) -> bool:
         with self._cv:
             return oid in self._objects
+
+    def publish(self, oid: ObjectID, blob, consumers: int,
+                kind: str = "blob") -> Optional[str]:
+        """Channel publication (compiled DAGs, ``ray_tpu.dag``): store an
+        already-serialized value under a PRE-ARRANGED id with a fixed
+        consumer budget. Each consumer fetches owner-direct and releases
+        one borrow after reading; the last release frees the slot — the
+        channel is a single-producer, counted-consumer mailbox.
+
+        Unlike ``put`` there is no local ref: lifetime is exactly the
+        consumer budget. ``kind="err"`` publishes a serialized error so
+        downstream stages unblock with the producer's failure instead of
+        timing out.
+        """
+        from ray_tpu._private.object_store import create_segment
+        blob = blob if isinstance(blob, bytes) else bytes(blob)
+        size = len(blob)
+        seg = None
+        if kind == "blob" and size > self.max_inline_bytes:
+            # Full oid in the name: a truncated prefix would be
+            # constant across one owner's puts/channels (it only covers
+            # the task-id prefix) and collide under load.
+            name = f"rtpu_own_{os.getpid()}_{oid.hex()}"
+            seg = create_segment(name, size)
+            seg.buf[:size] = blob
+            entry = ("shm", name, size)
+        else:
+            entry = (kind, blob)
+        with self._cv:
+            self._objects[oid] = entry
+            if seg is not None:
+                self._segments[oid] = seg
+            self._borrows[oid] = max(1, int(consumers))
+            self._cv.notify_all()
+        return entry[1] if seg is not None else None
+
+    # -- push channels (compiled DAGs) ---------------------------------
+
+    def accept_push(self, oid: ObjectID, entry: tuple, takes: int) -> None:
+        """Inbound channel value from an upstream stage's worker. The
+        entry lands in THIS consumer's directory so its resolve is a
+        local cv wait — no round trip on the data path. ``takes`` is the
+        number of resolves the consumer will perform (a node may use the
+        same upstream value in several arg positions)."""
+        with self._cv:
+            slot = self._pushed.get(oid)
+            if slot is not None:
+                # Defensive: a second push for the same channel id adds
+                # takes instead of clobbering the first (normally the
+                # compiler aggregates pushes per consumer core).
+                slot[1] += max(1, int(takes))
+            else:
+                self._pushed[oid] = [entry, max(1, int(takes))]
+            self._cv.notify_all()
+
+    def take_pushed(self, oid: ObjectID, timeout: Optional[float]) -> tuple:
+        """Consume one take of a pushed channel value; the last take
+        drops it."""
+        with self._cv:
+            if oid not in self._pushed:
+                ok = self._cv.wait_for(lambda: oid in self._pushed,
+                                       timeout)
+                if not ok:
+                    raise TimeoutError(
+                        f"channel value {oid} never arrived (upstream "
+                        "stage dead or still running)")
+            slot = self._pushed[oid]
+            slot[1] -= 1
+            if slot[1] <= 0:
+                del self._pushed[oid]
+            return slot[0]
 
     def get_local_blob(self, oid: ObjectID,
                        timeout: Optional[float] = None) -> tuple:
@@ -413,6 +492,65 @@ def release_borrow(addr: Tuple[str, int], oid: ObjectID) -> None:
         _peer(tuple(addr)).oneway("owner_release", oid.binary())
     except Exception:
         pass                  # owner already gone: nothing to release
+
+
+def push_channel_value(oid: ObjectID, blob: bytes, kind: str,
+                       consumers: Sequence[tuple]) -> None:
+    """Producer side of a compiled-DAG channel: deliver one serialized
+    value to every consumer core as a ONEWAY push (no round trip on the
+    data path). ``consumers``: [(core_addr, takes), ...]. Values past
+    the inline limit stay in the producer's core as a consumer-counted
+    shm segment; consumers get a locator and map it directly."""
+    core = get_worker_core()
+    big = kind == "blob" and len(blob) > core.max_inline_bytes
+    if big:
+        total = sum(t for _a, t in consumers)
+        name = core.publish(oid, blob, total)
+        entry = ("shmref", name, len(blob), core.address)
+    else:
+        entry = (kind, blob)
+    for addr, takes in consumers:
+        addr = tuple(addr)
+        if addr == core.address:
+            core.accept_push(oid, entry, takes)
+        else:
+            try:
+                _peer(addr).oneway("chan_push", oid.binary(), entry,
+                                   takes)
+            except Exception:
+                logger.warning("channel push to %s failed", addr,
+                               exc_info=True)
+                if big:
+                    # That consumer will never release its takes —
+                    # drain them now or the segment leaks for the
+                    # producer's lifetime.
+                    for _ in range(takes):
+                        core._h_release(None, oid.binary())
+
+
+def take_channel_value(oid: ObjectID,
+                       timeout: Optional[float] = None) -> Any:
+    """Consumer side: wait (locally) for the pushed value, deserialize,
+    raise stored producer errors. shm locators release the producer's
+    consumer-count after the bytes are read."""
+    core = get_worker_core()
+    entry = core.take_pushed(oid, timeout)
+    if entry[0] == "shmref":
+        _, name, size, paddr = entry
+        paddr = tuple(paddr)
+        try:
+            from multiprocessing import shared_memory
+            seg = shared_memory.SharedMemory(name=name, create=False)
+            data = bytes(seg.buf[:size])
+            seg.close()
+        except Exception:
+            # different machine / segment raced away: owner fetch
+            reply = _owner_call(paddr, "owner_get_bytes", oid.binary())
+            data = reply[1]
+        release_borrow(paddr, oid)
+        return _value_from_blob("val", data)
+    return _value_from_blob("err" if entry[0] == "err" else "val",
+                            entry[1])
 
 
 def owner_contains(addr: Tuple[str, int], oid: ObjectID) -> bool:

@@ -62,7 +62,12 @@ class ProcessWorker(BaseWorker):
         hub.expect(token, self._register)
         env = dict(os.environ)
         # Children never own the TPU; any jax they import runs on CPU.
+        # On remote-attached chips (axon tunnel) the sitecustomize hook
+        # dials the device from EVERY python process when the pool var
+        # is set — scrub it or a child's jax import blocks on the chip
+        # the driver already owns.
         env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         env["RAY_TPU_WORKER_MODE"] = "1"
         env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
         env["PYTHONPATH"] = os.pathsep.join(
@@ -130,8 +135,10 @@ class InProcessWorker(BaseWorker):
             op = msg[0]
             if op == "func":
                 self.env.cache_function(msg[1], msg[2])
+            elif op == "dag_stage":
+                self.env.dag_stages[msg[1]] = msg[2]
             elif op in ("exec", "create_actor", "exec_actor"):
-                payload = msg[1]
+                payload = self.env.merge_stage(msg[1])
                 emit = lambda r: self._reply(self, r)  # noqa: E731
                 conc = (self.env._actor_conc.get(
                     payload.get("actor_id"), 1)

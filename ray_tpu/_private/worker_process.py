@@ -84,9 +84,19 @@ class ExecutionEnv:
         self.actors: Dict[bytes, Any] = {}
         self._actor_envs: Dict[bytes, Optional[dict]] = {}
         self._actor_conc: Dict[bytes, int] = {}
+        # Compiled-DAG stage templates: the constant half of a stage's
+        # payload, registered once at compile time so per-execute
+        # messages ship only {task_id, args, return_ids, publish}.
+        self.dag_stages: Dict[bytes, dict] = {}
         self.shm_client = ShmClient(session)
         self.serde = serialization.get_context()
         self.current_task_name = ""
+
+    def merge_stage(self, payload: dict) -> dict:
+        key = payload.get("stage_key")
+        if key is None:
+            return payload
+        return {**self.dag_stages[key], **payload}
 
     @staticmethod
     def _apply_runtime_env(runtime_env: Optional[dict]) -> Callable[[], None]:
@@ -142,15 +152,25 @@ class ExecutionEnv:
             from ray_tpu._private.ids import ObjectID as _OID
             return worker_core.fetch_value_from_owner(
                 tuple(desc[2]), _OID(desc[1]), timeout=30.0)
+        if kind == "chanp":  # compiled-DAG channel: the upstream stage
+            # PUSHES its result into this consumer's core, so resolution
+            # is a local cv wait — no round trip on the data path. A
+            # producer failure arrives as a pushed error and re-raises.
+            from ray_tpu._private import worker_core
+            timeout = desc[2] if len(desc) > 2 else 60.0
+            return worker_core.take_channel_value(ObjectID(desc[1]),
+                                                  timeout=timeout)
         raise ValueError(f"bad arg descriptor {kind!r}")
 
     # -- result storage ----------------------------------------------------
 
-    def store_results(self, return_ids: List[bytes], values: tuple
-                      ) -> List[tuple]:
+    def store_results(self, return_ids: List[bytes], values: tuple,
+                      pre_ser=None) -> List[tuple]:
         out = []
         for oid_bytes, value in zip(return_ids, values):
-            ser = self.serde.serialize(value)
+            ser = pre_ser if pre_ser is not None else \
+                self.serde.serialize(value)
+            pre_ser = None        # only valid for the first (sole) value
             contained = [self._contained_desc(r)
                          for r in ser.contained_refs]
             size = ser.size_with_header()
@@ -218,8 +238,13 @@ class ExecutionEnv:
                     result = method(*args, **kwargs)
                 else:
                     result = fn(*args, **kwargs)
+                pre_ser = None
                 if payload.get("streaming"):
                     return self._drain_generator(payload, result, emit)
+                if payload.get("publish"):
+                    pre_ser = self.serde.serialize(result)
+                    self._publish_channels(payload["publish"],
+                                           pre_ser.to_bytes())
             finally:
                 if payload["type"] != "create_actor":
                     restore_env()
@@ -229,7 +254,11 @@ class ExecutionEnv:
                 raise ValueError(
                     f"task declared num_returns={n} but returned "
                     f"{len(values)} values")
-            results = self.store_results(payload["return_ids"], values)
+            # pre_ser: a terminal stage that also feeds channels reuses
+            # the channel serialization instead of re-serializing.
+            results = self.store_results(payload["return_ids"], values,
+                                         pre_ser=pre_ser if n == 1 else
+                                         None)
             return ("done", task_id, results, None)
         except BaseException as e:  # noqa: BLE001
             err = TaskError(e, task_repr=payload.get("name", "?"),
@@ -240,9 +269,28 @@ class ExecutionEnv:
                 blob = self.serde.serialize(
                     TaskError(None, payload.get("name", "?"),
                               traceback.format_exc())).to_bytes()
+            if payload.get("publish"):
+                # Unblock downstream channel consumers with the failure
+                # instead of letting them time out.
+                try:
+                    self._publish_channels(payload["publish"], blob,
+                                           kind="err")
+                except Exception:
+                    pass
             if payload["type"] == "create_actor":
                 return ("actor_ready", payload["actor_id"], blob)
             return ("done", task_id, [], blob)
+
+    @staticmethod
+    def _publish_channels(pubs, blob: bytes, kind: str = "blob") -> None:
+        """Push one serialized result to each pre-arranged consumer core
+        (the driver is not in the handoff). Channel values containing
+        ObjectRefs rely on prompt consumer-side borrow registration via
+        the deserialize hook — pass arrays/values, not ref graphs."""
+        from ray_tpu._private import worker_core
+        for oid_b, consumers in pubs:
+            worker_core.push_channel_value(ObjectID(oid_b), blob, kind,
+                                           consumers)
 
     def _drain_generator(self, payload: dict, result, emit) -> tuple:
         """Streaming task: store + emit each yielded item as it lands;
@@ -311,8 +359,10 @@ def worker_main(conn, session: str, max_inline_bytes: int,
                 break
             elif op == "func":
                 env.cache_function(msg[1], msg[2])
+            elif op == "dag_stage":
+                env.dag_stages[msg[1]] = msg[2]
             elif op in ("exec", "create_actor", "exec_actor"):
-                payload = msg[1]
+                payload = env.merge_stage(msg[1])
                 conc = (env._actor_conc.get(payload.get("actor_id"), 1)
                         if op == "exec_actor" else 1)
                 if conc > 1:
@@ -329,6 +379,11 @@ def worker_main(conn, session: str, max_inline_bytes: int,
                         lambda p=payload: send(env.execute(p, emit=send)))
                 else:
                     send(env.execute(payload, emit=send))
+            elif op == "core_addr":
+                # Compiled-DAG channel binding: report this process's
+                # owner-core address (creates the core on first ask).
+                send(("core_addr",
+                      worker_core.get_worker_core().address))
             elif op == "ping":
                 send(("pong",))
     finally:
