@@ -206,7 +206,8 @@ class NestedClient:
         if driver_refs:
             rpc_timeout = None if timeout is None else timeout + 30.0
             ready_b = self._client.call(
-                "nested_wait", [r.id().binary() for r in driver_refs],
+                "nested_wait", self._current_task_id(),
+                [r.id().binary() for r in driver_refs],
                 need, timeout, timeout=rpc_timeout)
             ready_set |= {ObjectID(b) for b in ready_b}
         ready, not_ready = [], []

@@ -484,6 +484,7 @@ class NodeManagerGroup:
             "runtime_env": spec.runtime_env,
             "owner_addr": self.object_server_addr,
             "streaming": spec.streaming,
+            "stream_skip": spec.stream_skip,
             "resources": dict(spec.resources),
         }
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
@@ -1099,6 +1100,7 @@ class NodeManagerGroup:
             "runtime_env": spec.runtime_env,
             "owner_addr": self.object_server_addr,
             "streaming": spec.streaming,
+            "stream_skip": spec.stream_skip,
         }
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             payload["actor_id"] = spec.actor_creation_id.binary()

@@ -121,17 +121,33 @@ class ObjectRefGenerator:
         from ray_tpu._private.worker import global_worker
         w = global_worker()
         done_oid = self._done_ref.id()
+        if hasattr(w, "memory_store"):      # driver: owner-store direct
+            while True:
+                item_oid = ObjectID.from_index(self._task_id, self._i + 2)
+                if w.memory_store.contains(item_oid):
+                    self._i += 1
+                    return ObjectRef(item_oid)
+                if w.memory_store.contains(done_oid):
+                    count = w.get([self._done_ref])[0]  # raises task errs
+                    if self._i >= count:
+                        raise StopIteration
+                    continue     # item landed between the two checks
+                w.memory_store.wait([item_oid, done_oid], 1, None)
+        # Inside a task/actor (nested client): poll the owner through
+        # the worker surface — wait releases the blocked parent's CPU
+        # so the generator task can run even at pool capacity.
         while True:
-            item_oid = ObjectID.from_index(self._task_id, self._i + 2)
-            if w.memory_store.contains(item_oid):
+            item_ref = ObjectRef(
+                ObjectID.from_index(self._task_id, self._i + 2))
+            ready, _ = w.wait([item_ref, self._done_ref], 1, None)
+            ids = {r.id() for r in ready}
+            if item_ref.id() in ids:
                 self._i += 1
-                return ObjectRef(item_oid)
-            if w.memory_store.contains(done_oid):
-                count = w.get([self._done_ref])[0]  # raises task errors
+                return item_ref
+            if done_oid in ids:
+                count = w.get([self._done_ref])[0]   # raises task errors
                 if self._i >= count:
                     raise StopIteration
-                continue     # item landed between the two checks
-            w.memory_store.wait([item_oid, done_oid], 1, None)
 
     def completed(self) -> ObjectRef:
         """The completion marker (resolves to the item count)."""

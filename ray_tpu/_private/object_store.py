@@ -63,6 +63,35 @@ except TypeError:  # pragma: no cover - older Python
     resource_tracker.unregister = _unregister
 
 
+def sweep_orphan_segments(session: str) -> None:
+    """End-of-session shm hygiene: unlink segments no live process can
+    reach — this session's node-store segments (covers workers killed
+    between segment creation and owner adoption) and owner-core
+    segments (``rtpu_own_<pid>_*``) whose process is dead (SIGKILL
+    bypasses WorkerCore cleanup). Foreign sessions' and live processes'
+    segments are untouched."""
+    import glob
+    for path in glob.glob(f"/dev/shm/rtpu_{session}*"):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    for path in glob.glob("/dev/shm/rtpu_own_*"):
+        try:
+            pid = int(os.path.basename(path).split("_")[2])
+        except (IndexError, ValueError):
+            continue
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        except PermissionError:
+            pass       # pid alive under another uid: leave it
+
+
 def _segment_name(session: str, object_id: ObjectID) -> str:
     # Full hex: an ObjectID's uniqueness lives in its TRAILING bytes
     # (task randomness + return index); any prefix truncation collides.

@@ -139,6 +139,11 @@ class TaskSpec:
     # Streaming generator task: returns yield incrementally; return_ids
     # holds only the completion marker (stores the item count).
     streaming: bool = False
+    # Retry resume point: yielded items below this index were
+    # already delivered to the owner by a previous attempt and
+    # are skipped (item-index dedup; assumes a deterministic
+    # generator prefix, the reference's replay semantics).
+    stream_skip: int = 0
     # filled by the driver at submission:
     return_ids: List[ObjectID] = field(default_factory=list)
     depth: int = 0

@@ -680,9 +680,17 @@ class Worker:
         self._store_result(oid, entry)
         return oid.binary()
 
-    def _nested_wait(self, ctx, oid_bytes_list, num_returns, timeout):
+    def _nested_wait(self, ctx, task_id_b: bytes, oid_bytes_list,
+                     num_returns, timeout):
         ids = [ObjectID(b) for b in oid_bytes_list]
-        ready, _ = self.memory_store.wait(ids, num_returns, timeout)
+        # Like nested_get: a parent blocked in wait() must lend its CPU
+        # or a child it waits on (e.g. a streaming generator launched
+        # from the task) can deadlock at pool capacity.
+        release = self._release_blocked_parent(task_id_b)
+        try:
+            ready, _ = self.memory_store.wait(ids, num_returns, timeout)
+        finally:
+            release()
         return [oid.binary() for oid in ready]
 
     def _release_blocked_parent(self, task_id_b: bytes):
@@ -887,10 +895,6 @@ class Worker:
                       for i in range(num_returns)]
         max_retries = (options.max_retries if options.max_retries is not None
                        else cfg.task_max_retries)
-        if streaming:
-            # Re-running a generator would collide with already-stored
-            # item segments; streamed tasks don't retry (v1).
-            max_retries = 0
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -922,6 +926,8 @@ class Worker:
         kind_map = {"inline": "blob", "shm": "shm", "remote": "remote"}
         for oid_b, kind, data, contained in results:
             oid = ObjectID(oid_b)
+            if self.memory_store.contains(oid):
+                continue   # duplicate delivery from a retried attempt
             self.reference_counter.add_owned_object(oid)
             entry = Entry(kind_map[kind], data,
                           tuple(ObjectID(c) for c in contained))
@@ -947,6 +953,17 @@ class Worker:
                 spec.placement_group_bundle_index = -1
 
     def _resubmit(self, spec: TaskSpec) -> None:
+        if spec.streaming:
+            # Item-index dedup (reference: generator replays skip
+            # already-delivered items): items this owner already holds
+            # were delivered by the previous attempt — the retry's
+            # generator drains past them without re-storing. Emission is
+            # ordered, so the delivered prefix is contiguous.
+            i = 0
+            while self.memory_store.contains(
+                    ObjectID.from_index(spec.task_id, i + 2)):
+                i += 1
+            spec.stream_skip = i
         if spec.task_type == TaskType.ACTOR_TASK:
             with self._actor_lock:
                 queue = self._actor_queues.get(spec.actor_id)
@@ -1332,6 +1349,11 @@ class Worker:
                 self.gcs.close()
             except Exception:
                 pass
+        if self._join_address is None:
+            # Session owner: sweep shm orphans left by killed workers.
+            from ray_tpu._private.object_store import (
+                sweep_orphan_segments)
+            sweep_orphan_segments(self.session)
 
     def cluster_resources(self) -> Dict[str, float]:
         total: Dict[str, float] = {}

@@ -538,19 +538,34 @@ def take_channel_value(oid: ObjectID,
     if entry[0] == "shmref":
         _, name, size, paddr = entry
         paddr = tuple(paddr)
-        try:
-            from multiprocessing import shared_memory
-            seg = shared_memory.SharedMemory(name=name, create=False)
-            data = bytes(seg.buf[:size])
-            seg.close()
-        except Exception:
-            # different machine / segment raced away: owner fetch
-            reply = _owner_call(paddr, "owner_get_bytes", oid.binary())
-            data = reply[1]
+        # Shared shm-map-with-owner-fallback path (handles a raced-away
+        # segment and a "gone" reply with a meaningful error).
+        kind, data = _blob_from_reply(paddr, oid, ("shm", name, size))
         release_borrow(paddr, oid)
-        return _value_from_blob("val", data)
+        return _value_from_blob(kind, data)
     return _value_from_blob("err" if entry[0] == "err" else "val",
                             entry[1])
+
+
+def drain_channel_args(arg_descs) -> None:
+    """Best-effort cleanup when a stage fails before resolving all its
+    channel args: consume whatever already arrived so pushed entries
+    (and big values' producer-side segments) don't leak. Values that
+    arrive after the failure still leak until the worker exits — a
+    bounded, documented gap."""
+    core = try_worker_core()
+    if core is None:
+        return
+    for desc in arg_descs or ():
+        if not desc or desc[0] != "chanp":
+            continue
+        oid = ObjectID(desc[1])
+        try:
+            entry = core.take_pushed(oid, timeout=0)
+        except TimeoutError:
+            continue
+        if entry[0] == "shmref":
+            release_borrow(tuple(entry[3]), oid)
 
 
 def owner_contains(addr: Tuple[str, int], oid: ObjectID) -> bool:

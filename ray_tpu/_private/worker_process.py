@@ -96,7 +96,16 @@ class ExecutionEnv:
         key = payload.get("stage_key")
         if key is None:
             return payload
-        return {**self.dag_stages[key], **payload}
+        template = self.dag_stages.get(key)
+        if template is None:
+            # Stage template lost (e.g. this worker restarted after the
+            # DAG was compiled): fail the ONE task with an actionable
+            # error instead of KeyError-ing the whole worker loop.
+            return {**payload, "type": "exec_actor",
+                    "num_returns": len(payload.get("return_ids", ())),
+                    "kwargs_keys": [], "name": "compiled-dag-stage",
+                    "_missing_stage": True}
+        return {**template, **payload}
 
     @staticmethod
     def _apply_runtime_env(runtime_env: Optional[dict]) -> Callable[[], None]:
@@ -179,7 +188,20 @@ class ExecutionEnv:
             else:
                 oid = ObjectID(oid_bytes)
                 name = _segment_name(self.session, oid)
-                seg = create_segment(name, size)
+                try:
+                    seg = create_segment(name, size)
+                except FileExistsError:
+                    # Orphan from a previous attempt of THIS task that
+                    # died after creating the segment but before the
+                    # owner heard about it (had the owner adopted it,
+                    # the retry would have skipped this item). Reclaim
+                    # the name.
+                    from multiprocessing import shared_memory
+                    old = shared_memory.SharedMemory(name=name,
+                                                     create=False)
+                    old.unlink()
+                    old.close()
+                    seg = create_segment(name, size)
                 try:
                     ser.write_into(seg.buf)
                 finally:
@@ -216,6 +238,11 @@ class ExecutionEnv:
         _TASK_FALLBACK["owner_addr"] = payload.get("owner_addr")
         _TASK_FALLBACK["task_id"] = task_id
         try:
+            if payload.get("_missing_stage"):
+                raise RuntimeError(
+                    "compiled-DAG stage template missing (the actor's "
+                    "worker restarted after compilation); recompile "
+                    "the DAG with experimental_compile()")
             fn = self._get_callable(payload)
             args, kwargs = self.resolve_args(payload["args"],
                                              payload["kwargs_keys"])
@@ -277,6 +304,13 @@ class ExecutionEnv:
                                            kind="err")
                 except Exception:
                     pass
+            # Failed before consuming our own channel args? Drain what
+            # arrived so pushed entries / producer segments don't leak.
+            try:
+                from ray_tpu._private import worker_core
+                worker_core.drain_channel_args(payload.get("args"))
+            except Exception:
+                pass
             if payload["type"] == "create_actor":
                 return ("actor_ready", payload["actor_id"], blob)
             return ("done", task_id, [], blob)
@@ -304,8 +338,14 @@ class ExecutionEnv:
                 f"generator, got {type(result).__name__}")
         tid = TaskID(task_id)
         count = 0
+        # Retry resume: the owner already holds the first ``stream_skip``
+        # items — drain past them without re-storing (their segments
+        # exist and are owned elsewhere; re-creating them would collide).
+        skip = payload.get("stream_skip", 0)
         for item in result:
             count += 1
+            if count <= skip:
+                continue
             oid_b = ObjectID.from_index(tid, count + 1).binary()
             stored = self.store_results([oid_b], (item,))
             if emit is not None:

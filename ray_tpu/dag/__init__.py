@@ -394,15 +394,38 @@ class CompiledDAG:
                 "publish": publish,
             }
             if not self._submit_with_retry(w, st, spec, payload):
-                raise ActorDiedError(
+                err = ActorDiedError(
                     f"compiled-DAG stage {st.name} has no live worker "
                     "(actor died or is restarting); re-create the actor "
                     "and recompile")
+                # Unwind: complete the stage task (records + terminal
+                # refs get the error) and push the error to consumers
+                # already waiting on this stage's channel, so they fail
+                # fast instead of blocking out the channel timeout.
+                blob = w.serde.serialize(err).to_bytes()
+                w.task_manager.complete_task(task_id, [], blob, None)
+                for oid_b, consumers in publish:
+                    self._push_error_to_consumers(oid_b, blob, consumers)
+                raise err
             if st.terminal:
                 out_refs[st.pos] = ObjectRef(return_ids[0])
         outs = [out_refs[p] for p in self._terminal_order]
         return outs if isinstance(self.output, MultiOutputNode) \
             else outs[0]
+
+    @staticmethod
+    def _push_error_to_consumers(oid_b: bytes, err_blob: bytes,
+                                 consumers) -> None:
+        """Driver-side stand-in for the dead producer: deliver its
+        failure into each consumer core's channel slot."""
+        from ray_tpu._private import worker_core
+        from ray_tpu._private.ids import ObjectID
+        for addr, takes in consumers:
+            try:
+                worker_core._peer(tuple(addr)).oneway(
+                    "chan_push", oid_b, ("err", err_blob), takes)
+            except Exception:
+                pass
 
     @staticmethod
     def _submit_with_retry(w, st: _Stage, spec, payload,

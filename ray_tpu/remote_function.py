@@ -67,10 +67,6 @@ class RemoteFunction:
         apply_placement_group_option(opts)
         w = global_worker()
         if opts.num_returns == "streaming":
-            if not hasattr(w, "memory_store"):
-                raise NotImplementedError(
-                    "streaming generators inside tasks are not "
-                    "supported yet")
             from ray_tpu._private.object_ref import ObjectRefGenerator
             refs = w.submit_task(self._get_descriptor(), args, kwargs,
                                  opts)

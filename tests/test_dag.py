@@ -237,11 +237,13 @@ def test_compiled_dag_dispatch_beats_uncompiled(ray_start_regular):
         t0 = _time.perf_counter()
         run_c()
         cs.append(_time.perf_counter() - t0)
-    us.sort(), cs.sort()
-    fast, uncompiled = cs[len(cs) // 2], us[len(us) // 2]
+    # Best-of-N: the min is the achievable dispatch latency with
+    # scheduler noise filtered out — medians flake under background
+    # load on small shared machines.
+    fast, uncompiled = min(cs), min(us)
     assert fast < uncompiled, (
-        f"compiled median {fast * 1e6:.0f}µs not better than "
-        f"uncompiled {uncompiled * 1e6:.0f}µs")
+        f"compiled best {fast * 1e6:.0f}µs not better than "
+        f"uncompiled best {uncompiled * 1e6:.0f}µs")
 
 
 def test_compiled_dag_same_actor_consumes_twice(ray_start_regular):

@@ -8,6 +8,7 @@ and bounded by ``max_retries``.
 """
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -107,9 +108,14 @@ def test_reconstruct_lost_spill_file():
 
         refs = [make.remote(i) for i in range(3)]
         ray_tpu.get(refs[-1])
-        # Wait for spills triggered by capacity pressure, then destroy
-        # every spill file.
-        spilled = dict(w.shm_store._spilled)
+        # Spilling under capacity pressure is asynchronous — poll for
+        # it instead of snapshotting immediately (loaded machines lag).
+        deadline = time.monotonic() + 15
+        spilled = {}
+        while not spilled and time.monotonic() < deadline:
+            spilled = dict(w.shm_store._spilled)
+            if not spilled:
+                time.sleep(0.05)
         assert spilled, "expected at least one spilled object"
         for path, _size in spilled.values():
             os.unlink(path)

@@ -87,3 +87,44 @@ def test_streaming_on_remote_raylet(ray_start_cluster):
 
     vals = [float(ray_tpu.get(r)[0]) for r in gen.remote(3)]
     assert vals == [0.0, 1.0, 2.0]
+
+
+def test_streaming_retry_after_worker_death(ray_start_regular, tmp_path):
+    """A streaming task killed mid-stream retries with item-index dedup:
+    already-delivered items are kept (not re-stored, not duplicated) and
+    the retry resumes past them."""
+    marker = str(tmp_path / "attempt")
+
+    @ray_tpu.remote(num_returns="streaming", max_retries=1)
+    def gen(n):
+        import os
+        first_attempt = not os.path.exists(marker)
+        if first_attempt:
+            with open(marker, "w") as f:
+                f.write("1")
+        for i in range(n):
+            yield i * 10
+            if first_attempt and i == 2:
+                # items 0..2 are out; die hard mid-stream
+                os._exit(1)
+
+    g = gen.remote(6)
+    out = [ray_tpu.get(ref, timeout=60) for ref in g]
+    assert out == [0, 10, 20, 30, 40, 50]
+
+
+def test_streaming_launched_from_inside_task(ray_start_regular):
+    """Tasks can launch and consume streaming generators (nested-client
+    path: the generator handle polls the owner via the worker surface)."""
+
+    @ray_tpu.remote(num_returns="streaming")
+    def inner(n):
+        for i in range(n):
+            yield i + 100
+
+    @ray_tpu.remote
+    def outer(n):
+        g = inner.remote(n)
+        return [ray_tpu.get(ref) for ref in g]
+
+    assert ray_tpu.get(outer.remote(4), timeout=60) == [100, 101, 102, 103]
