@@ -162,6 +162,9 @@ _D("health_check_failure_threshold", int, 5,
 # --- logging / events ---
 _D("event_log_enabled", bool, True, "Structured event log to session dir.")
 _D("log_level", str, "INFO", "Runtime log level.")
+_D("log_to_driver", bool, True,
+   "Stream worker stdout/stderr (local files + remote raylet "
+   "read_logs) to the driver's stderr.")
 
 
 _global_config: Config | None = None

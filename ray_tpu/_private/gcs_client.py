@@ -91,8 +91,10 @@ class GcsClient:
         return self._call("get_all_node_info")
 
     def report_resources(self, node_id: NodeID,
-                         available: Dict[str, float]) -> None:
-        self._client.oneway("report_resources", node_id, available)
+                         available: Dict[str, float],
+                         stats: Optional[dict] = None) -> None:
+        self._client.oneway("report_resources", node_id, available,
+                            stats)
 
     # -- actors --------------------------------------------------------
 

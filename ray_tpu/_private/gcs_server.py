@@ -161,10 +161,13 @@ class GcsServer:
         self.state.update_actor_state(actor_id, state, cause)
 
     def _report_resources(self, ctx: ConnectionContext, node_id: NodeID,
-                          available: Dict[str, float]) -> None:
+                          available: Dict[str, float],
+                          stats: Optional[dict] = None) -> None:
         """Raylet resource report (reference: ray_syncer broadcast);
-        relayed to RESOURCES subscribers (the scheduler's view)."""
-        self._publish("RESOURCES", (node_id, available))
+        relayed to RESOURCES subscribers (the scheduler's view +
+        per-node metrics). ``stats`` is the raylet's small metrics
+        dict (queue/running/store counters)."""
+        self._publish("RESOURCES", (node_id, available, stats))
 
     def _subscribe(self, ctx: ConnectionContext, channel: str) -> None:
         with self._subs_lock:

@@ -101,6 +101,7 @@ class RayletServer:
         self.server.register("ping", lambda ctx: "pong")
         self.server.register("register_owner", self._register_owner)
         self.server.register("stats", lambda ctx: self.stats())
+        self.server.register("read_logs", self._handle_read_logs)
         self.server.register("submit", self._handle_submit)
         self.server.register("kill_actor", self._handle_kill_actor)
         self.server.register("adjust_pool", self._handle_adjust_pool)
@@ -175,6 +176,14 @@ class RayletServer:
                 pass
             worker.kill()
             self.worker_pool.remove_worker(worker)
+
+    def _handle_read_logs(self, ctx, cursor):
+        """Per-node agent log plane: incremental tail over this node's
+        worker stdout/stderr files (the driver's log monitor and the
+        ``logs --follow`` CLI poll this)."""
+        from ray_tpu._private.log_monitor import (read_new_log_bytes,
+                                                  session_log_dir)
+        return read_new_log_bytes(session_log_dir(self.session), cursor)
 
     def _handle_adjust_pool(self, ctx, delta: int) -> None:
         """Owner-directed worker-slot adjustment: a parent task blocked
@@ -442,9 +451,25 @@ class RayletServer:
         while not self._shutdown.wait(period):
             try:
                 self.gcs.report_resources(self.node_id,
-                                          self.available_resources())
+                                          self.available_resources(),
+                                          stats=self._metric_stats())
             except Exception:
                 pass
+
+    def _metric_stats(self) -> dict:
+        """Small per-node stats dict shipped with each heartbeat; the
+        driver exports these as per-node Prometheus series."""
+        store = self.shm_store.stats()
+        with self._lock:
+            return {
+                "queued_tasks": len(self._dispatch_queue),
+                "running_tasks": len(self._running),
+                "actors": len(self._actor_workers),
+                "objects_pulled": self.num_pulled,
+                "store_used_bytes": store["used_bytes"],
+                "store_num_objects": store["num_objects"],
+                "workers": self.worker_pool.stats()["total"],
+            }
 
     # -- lifecycle -----------------------------------------------------
 

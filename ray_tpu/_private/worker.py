@@ -207,6 +207,9 @@ class Worker:
         # raylet's truth overrides the driver's optimistic view within
         # one heartbeat — and keep the raw reports for the dashboard.
         self.node_reports: Dict[NodeID, Tuple[float, Dict[str, float]]] = {}
+        self.node_stats: Dict[NodeID, Tuple[float, dict]] = {}
+        # streaming tasks: highest item index delivered (retry resume)
+        self._stream_progress: Dict[TaskID, int] = {}
         self.gcs.publisher.subscribe("RESOURCES", self._on_resource_report)
 
         # per-actor ordered submission queues; _actor_flush_locks
@@ -221,7 +224,17 @@ class Worker:
 
         from ray_tpu._private.stats import install_runtime_metrics
         install_runtime_metrics()
+        self._install_node_metrics()
         self._register_nested_handlers()
+
+        # Per-node agent log plane: tail local worker stdout/stderr
+        # files + every remote raylet's read_logs RPC to the driver
+        # console (reference: log_monitor.py, log_to_driver).
+        self._log_monitor = None
+        if cfg.log_to_driver:
+            from ray_tpu._private.log_monitor import LogMonitor
+            self._log_monitor = LogMonitor.for_session(
+                self.session, self._remote_log_sources)
 
         if self._join_address is not None:
             self._attach_cluster_nodes()
@@ -355,10 +368,83 @@ class Worker:
         self.node_group.on_object_available(oid)
         self._flush_actor_queues()
 
+    def _remote_log_sources(self):
+        """[(node_hex, rpc_client)] for every live remote raylet."""
+        out = []
+        with self.node_group._lock:
+            handles = list(self.node_group._remote_nodes.items())
+        for node_id, handle in handles:
+            if handle.alive:
+                out.append((node_id.hex(), handle.client))
+        return out
+
+    def _install_node_metrics(self) -> None:
+        """Per-node Prometheus series (reference: per-node metrics agent
+        feeding one scrape endpoint): resource totals/availability from
+        the scheduler ledger + raylet heartbeat stats, refreshed at
+        scrape time via a registry collector."""
+        from ray_tpu.util import metrics
+        avail_g = metrics.Gauge(
+            "ray_tpu_node_resource_available",
+            "Per-node available resource units",
+            tag_keys=("node", "resource"))
+        total_g = metrics.Gauge(
+            "ray_tpu_node_resource_total",
+            "Per-node total resource units",
+            tag_keys=("node", "resource"))
+        stat_g = metrics.Gauge(
+            "ray_tpu_node_stat",
+            "Per-node raylet stats (queued/running tasks, actors, "
+            "store bytes/objects, workers, pulls)",
+            tag_keys=("node", "stat"))
+
+        def collect():
+            if self._shutdown:
+                return
+            # Rebuild from live state each scrape: dead nodes' series
+            # vanish instead of exporting their last values forever.
+            avail_g.clear()
+            total_g.clear()
+            stat_g.clear()
+            for nid, res in self.node_group.cluster_resources.nodes():
+                node = nid.hex()[:12]
+                for k, v in res.total.items():
+                    total_g.set(v, tags={"node": node, "resource": k})
+                for k, v in res.available.items():
+                    avail_g.set(v, tags={"node": node, "resource": k})
+            head = self.node_group.head_node_id
+            heads = {
+                "queued_tasks": len(self.node_group._to_schedule),
+                "running_tasks": len(self.node_group._running),
+                "actors": len(self.node_group._actor_workers),
+                "store_used_bytes":
+                    self.shm_store.stats()["used_bytes"],
+                "store_num_objects":
+                    self.shm_store.stats()["num_objects"],
+            }
+            for k, v in heads.items():
+                stat_g.set(float(v),
+                           tags={"node": head.hex()[:12], "stat": k})
+            stale = 3 * get_config().health_check_period_ms / 1000.0
+            now = time.time()
+            for nid, (ts, stats) in list(self.node_stats.items()):
+                if now - ts > stale:
+                    self.node_stats.pop(nid, None)   # stopped beating
+                    continue
+                for k, v in stats.items():
+                    stat_g.set(float(v), tags={"node": nid.hex()[:12],
+                                               "stat": k})
+
+        metrics.register_collector(collect)
+        self._node_metrics_collector = collect
+
     def _on_resource_report(self, message) -> None:
         try:
-            node_id, available = message
+            node_id, available = message[0], message[1]
+            stats = message[2] if len(message) > 2 else None
             self.node_reports[node_id] = (time.time(), dict(available))
+            if stats:
+                self.node_stats[node_id] = (time.time(), dict(stats))
             if node_id != self.node_group.head_node_id:
                 self.node_group.cluster_resources.apply_report(
                     node_id, available)
@@ -926,8 +1012,12 @@ class Worker:
         kind_map = {"inline": "blob", "shm": "shm", "remote": "remote"}
         for oid_b, kind, data, contained in results:
             oid = ObjectID(oid_b)
-            if self.memory_store.contains(oid):
+            # item N lives at return index N+1 (index 1 = done marker)
+            item_no = oid.index() - 1
+            prev = self._stream_progress.get(task_id, 0)
+            if item_no <= prev:
                 continue   # duplicate delivery from a retried attempt
+            self._stream_progress[task_id] = item_no
             self.reference_counter.add_owned_object(oid)
             entry = Entry(kind_map[kind], data,
                           tuple(ObjectID(c) for c in contained))
@@ -955,15 +1045,11 @@ class Worker:
     def _resubmit(self, spec: TaskSpec) -> None:
         if spec.streaming:
             # Item-index dedup (reference: generator replays skip
-            # already-delivered items): items this owner already holds
-            # were delivered by the previous attempt — the retry's
-            # generator drains past them without re-storing. Emission is
-            # ordered, so the delivered prefix is contiguous.
-            i = 0
-            while self.memory_store.contains(
-                    ObjectID.from_index(spec.task_id, i + 2)):
-                i += 1
-            spec.stream_skip = i
+            # already-delivered items): resume past the highest item the
+            # owner RECEIVED (tracked at delivery — scanning the store
+            # would under-count, since consumed items may already have
+            # been freed on ref-drop).
+            spec.stream_skip = self._stream_progress.get(spec.task_id, 0)
         if spec.task_type == TaskType.ACTOR_TASK:
             with self._actor_lock:
                 queue = self._actor_queues.get(spec.actor_id)
@@ -1003,6 +1089,10 @@ class Worker:
             self._on_actor_creation_done(spec, err_blob, system_error)
         self.task_manager.complete_task(task_id, results, err_blob,
                                         system_error)
+        if spec is not None and spec.streaming:
+            rec = self.task_manager.get_record(task_id)
+            if rec is not None and rec.status in ("finished", "failed"):
+                self._stream_progress.pop(task_id, None)
 
     # ------------------------------------------------------------------
     # placement groups
@@ -1321,6 +1411,11 @@ class Worker:
         if self._shutdown:
             return
         self._shutdown = True
+        if getattr(self, "_log_monitor", None) is not None:
+            self._log_monitor.stop()
+        from ray_tpu.util import metrics as _metrics
+        _metrics.unregister_collector(
+            getattr(self, "_node_metrics_collector", None))
         self.reference_counter.freeze()
         from ray_tpu._private import worker_core as _wc
         core = _wc.try_worker_core()

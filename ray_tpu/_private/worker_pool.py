@@ -69,6 +69,7 @@ class ProcessWorker(BaseWorker):
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["RAY_TPU_WORKER_MODE"] = "1"
+        env["PYTHONUNBUFFERED"] = "1"   # timely stdout capture to logs
         env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
         env["PYTHONPATH"] = os.pathsep.join(
             [os.path.dirname(os.path.dirname(os.path.dirname(
@@ -76,11 +77,21 @@ class ProcessWorker(BaseWorker):
             + env.get("PYTHONPATH", "").split(os.pathsep))
         entry = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "worker_entry.py")
-        self.proc = subprocess.Popen(
-            [sys.executable, entry,
-             "--address", hub.address, "--token", token,
-             "--session", session, "--max-inline", str(max_inline_bytes)],
-            env=env, start_new_session=True)
+        # Per-worker stdout/stderr capture (reference: worker logs under
+        # /tmp/ray/session_*/logs): the node's log monitor / read_logs
+        # RPC tails these files to the driver.
+        from ray_tpu._private.log_monitor import worker_log_path
+        self.log_path = worker_log_path(session, self.worker_id.hex())
+        log = open(self.log_path, "ab", buffering=0)
+        try:
+            self.proc = subprocess.Popen(
+                [sys.executable, entry,
+                 "--address", hub.address, "--token", token,
+                 "--session", session, "--max-inline",
+                 str(max_inline_bytes)],
+                env=env, start_new_session=True, stdout=log, stderr=log)
+        finally:
+            log.close()
         self.start_time = time.monotonic()
 
     def _register(self, conn, pid: int) -> None:

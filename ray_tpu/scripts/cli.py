@@ -149,9 +149,14 @@ def _cmd_job(args) -> int:
 
 
 def _cmd_logs(args) -> int:
-    """List or tail session daemon logs (GCS, raylets, jobs)."""
+    """List or tail session daemon + worker logs. ``--follow`` streams
+    live, including each REMOTE raylet's worker output via its
+    ``read_logs`` RPC (per-node agent log plane)."""
     import glob
+    if args.follow:
+        return _follow_logs(args)
     paths = sorted(glob.glob("/tmp/rtpu_*/*.log")
+                   + glob.glob("/tmp/rtpu_*/logs/*.out")
                    + glob.glob("/tmp/rtpu_jobs/*.log"))
     if args.session:
         paths = [p for p in paths if args.session in p]
@@ -170,6 +175,56 @@ def _cmd_logs(args) -> int:
             print(line, end="")
         print()
     return 0
+
+
+def _remote_log_sources(address: str):
+    """[(node_hex, rpc_client)] for every reachable raylet registered
+    at the GCS (the LogMonitor's remote-source shape)."""
+    from ray_tpu._private.gcs_client import GcsClient
+    from ray_tpu._private.rpc import RpcClient
+    host, port = address.rsplit(":", 1)
+    gcs = GcsClient((host, int(port)))
+    sources = []
+    try:
+        for info in gcs.get_all_node_info():
+            if not info.alive or info.rpc_addr is None:
+                continue
+            try:
+                client = RpcClient(tuple(info.rpc_addr))
+            except OSError:
+                continue       # node listed but unreachable: skip it
+            sources.append((info.node_id.hex(), client))
+    finally:
+        gcs.close()
+    return sources
+
+
+def _follow_logs(args) -> int:
+    """The driver's LogMonitor, run in the foreground with a stdout
+    sink — one shared tail implementation (cursoring, rotation, UTF-8
+    boundaries live in log_monitor.py only)."""
+    import glob
+    import time as _time
+
+    from ray_tpu._private.log_monitor import LogMonitor
+    remote = []
+    if args.address:
+        if getattr(args, "token", ""):
+            from ray_tpu._private import rpc as _rpc
+            _rpc.set_session_token(args.token)
+        remote = _remote_log_sources(args.address)
+    pattern = f"/tmp/rtpu_{args.session or ''}*/logs"
+    monitor = LogMonitor(
+        local_dirs=lambda: glob.glob(pattern),
+        remote_sources=lambda: remote,
+        sink=lambda line: print(line, flush=True),
+        start=False)
+    try:
+        while True:
+            monitor.poll_once()
+            _time.sleep(0.5)
+    except KeyboardInterrupt:
+        return 0
 
 
 def _cmd_workflows(args) -> int:
@@ -218,6 +273,12 @@ def main(argv=None) -> int:
     sp.add_argument("--session", default="")
     sp.add_argument("--list", action="store_true")
     sp.add_argument("--tail", type=int, default=50)
+    sp.add_argument("--follow", action="store_true",
+                    help="stream live, incl. remote raylets' worker "
+                         "output (needs --address for remote nodes)")
+    sp.add_argument("--address", default="",
+                    help="GCS host:port for remote-node log streaming")
+    sp.add_argument("--token", default="", help="session token")
     sp.set_defaults(fn=_cmd_logs)
 
     sp = sub.add_parser("job", help="submit/track jobs")

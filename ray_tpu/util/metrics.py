@@ -99,6 +99,13 @@ class Gauge(Metric):
         with self._lock:
             return [(self.name, k, v) for k, v in self._values.items()]
 
+    def clear(self) -> None:
+        """Drop every series. Collector-refreshed gauges call this at
+        scrape time so series for entities that no longer exist (dead
+        nodes) disappear instead of exporting stale values forever."""
+        with self._lock:
+            self._values.clear()
+
 
 class Histogram(Metric):
     kind = "histogram"
@@ -148,6 +155,13 @@ def register_collector(fn) -> None:
     runtime state (the pull-model equivalent of the reference's
     metrics agent export loop)."""
     _collectors.append(fn)
+
+
+def unregister_collector(fn) -> None:
+    try:
+        _collectors.remove(fn)
+    except ValueError:
+        pass
 
 
 def prometheus_text() -> str:

@@ -21,6 +21,7 @@ def list_nodes() -> List[dict]:
                w.node_group.cluster_resources.nodes()}
     for info in w.gcs.get_all_node_info():
         res = cluster.get(info.node_id)
+        stats = w.node_stats.get(info.node_id)
         out.append({
             "node_id": info.node_id.hex(),
             "alive": info.alive,
@@ -29,6 +30,9 @@ def list_nodes() -> List[dict]:
             "labels": dict(info.labels),
             "is_head": info.node_id == w.node_group.head_node_id,
             "remote": info.node_id in w.node_group._remote_nodes,
+            # latest heartbeat stats from the node's raylet (per-node
+            # agent plane); {} for the head (see /metrics for its view)
+            "stats": dict(stats[1]) if stats else {},
         })
     return out
 
