@@ -198,6 +198,8 @@ class NodeManagerGroup:
 
         self.cluster_resources = ClusterResourceManager()
         self.dependency_manager = DependencyManager()
+        from ray_tpu._private.pip_env import PipEnvManager
+        self._pip_envs = PipEnvManager(self._on_pip_env_requeue)
         self.pg_manager = None  # set by the owning Worker after init
         self._fail_task_cb = None  # (spec, exception) -> None; set by Worker
         self._recover_object_cb = None  # (ObjectID) -> bool; set by Worker
@@ -994,6 +996,14 @@ class NodeManagerGroup:
         for raylet in raylets:
             self._dispatch_node(raylet)
 
+    def _on_pip_env_requeue(self, parked: list) -> None:
+        """A venv build finished (ready or failed): re-queue the specs
+        parked on it; dispatch re-polls and leases or fails them."""
+        with self._lock:
+            for raylet, spec in parked:
+                raylet.dispatch_queue.append(spec)
+        self._wake.set()
+
     def _dispatch_node(self, raylet: Raylet) -> None:
         while True:
             with self._lock:
@@ -1001,7 +1011,37 @@ class NodeManagerGroup:
                     return
                 spec = raylet.dispatch_queue.popleft()
             dedicated = spec.task_type == TaskType.ACTOR_CREATION_TASK
-            worker = raylet.worker_pool.pop_worker(spec.resources, dedicated)
+            env_tag = python_exe = None
+            pip_spec = (spec.runtime_env or {}).get("pip")
+            if pip_spec is not None:
+                if raylet.worker_pool.substrate_for(
+                        spec.resources) == "in_process":
+                    self._free_allocation(raylet.node_id, spec.resources,
+                                          self._spec_pg(spec))
+                    if self._fail_task_cb is not None:
+                        self._fail_task_cb(spec, ValueError(
+                            "pip runtime envs cannot demand TPU: TPU "
+                            "work runs in-process in the host that owns "
+                            "the chips"))
+                    continue
+                status, key, detail = self._pip_envs.poll(
+                    pip_spec, park_item=(raylet, spec))
+                if status == "building":
+                    # Parked (atomically, inside poll) until the venv
+                    # build finishes; the requeue callback re-queues us.
+                    # The allocation stays held — the task WILL run here.
+                    continue
+                if status == "failed":
+                    self._free_allocation(raylet.node_id, spec.resources,
+                                          self._spec_pg(spec))
+                    if self._fail_task_cb is not None:
+                        self._fail_task_cb(spec, RuntimeError(
+                            f"runtime_env pip build failed: {detail}"))
+                    continue
+                env_tag, python_exe = key, detail
+            worker = raylet.worker_pool.pop_worker(
+                spec.resources, dedicated, env_tag=env_tag,
+                python_exe=python_exe)
             if worker is None:
                 with self._lock:
                     raylet.dispatch_queue.appendleft(spec)

@@ -94,6 +94,8 @@ class RayletServer:
         self._wake = threading.Event()
         self._shutdown = threading.Event()
         self.num_pulled = 0   # objects fetched from peers (transfer stat)
+        from ray_tpu._private.pip_env import PipEnvManager
+        self._pip_envs = PipEnvManager(self._on_pip_env_requeue)
 
         self.server = RpcServer()
         self.address = self.server.address
@@ -219,13 +221,48 @@ class RayletServer:
                 self._dispatch_actor_task(payload)
                 continue
             dedicated = payload["type"] == "create_actor"
+            env_tag = python_exe = None
+            pip_spec = (payload.get("runtime_env") or {}).get("pip")
+            if pip_spec is not None:
+                if self.worker_pool.substrate_for(
+                        payload.get("resources") or {}) == "in_process":
+                    self._fail_payload(payload, ValueError(
+                        "pip runtime envs cannot demand TPU: TPU work "
+                        "runs in-process in the host that owns the "
+                        "chips"))
+                    continue
+                status, key, detail = self._pip_envs.poll(
+                    pip_spec, park_item=payload)
+                if status == "building":
+                    continue      # parked atomically inside poll
+                if status == "failed":
+                    self._fail_payload(payload, RuntimeError(
+                        f"runtime_env pip build failed: {detail}"))
+                    continue
+                env_tag, python_exe = key, detail
             worker = self.worker_pool.pop_worker(
-                payload.get("resources") or {"CPU": 1}, dedicated)
+                payload.get("resources") or {"CPU": 1}, dedicated,
+                env_tag=env_tag, python_exe=python_exe)
             if worker is None:
                 with self._lock:
                     self._dispatch_queue.appendleft(payload)
                 return
             self._run_on_worker(worker, payload)
+
+    def _on_pip_env_requeue(self, parked: list) -> None:
+        with self._lock:
+            self._dispatch_queue.extend(parked)
+        self._wake.set()
+
+    def _fail_payload(self, payload: dict, err: Exception) -> None:
+        """Complete a payload with an APP-level error (no retry)."""
+        from ray_tpu._private import serialization
+        from ray_tpu.exceptions import TaskError
+        blob = serialization.get_context().serialize(
+            TaskError(err, payload.get("name", "?"), str(err))).to_bytes()
+        self._push_owner("task_done", {
+            "task_id": payload["task_id"], "results": [],
+            "error_blob": blob, "system_error": None})
 
     def _dispatch_actor_task(self, payload: dict) -> None:
         actor_id = payload["actor_id"]

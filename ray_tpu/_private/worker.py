@@ -61,14 +61,14 @@ class _LostObjectSignal(Exception):
     should attempt lineage reconstruction."""
 
 
-_SUPPORTED_RUNTIME_ENV_KEYS = {"env_vars", "working_dir"}
+_SUPPORTED_RUNTIME_ENV_KEYS = {"env_vars", "working_dir", "pip"}
 
 
 def _validate_runtime_env(runtime_env: Optional[dict]) -> Optional[dict]:
-    """Reference runtime envs carry pip/conda/containers built by a
-    per-node agent; this runtime ships the per-task pieces that apply
-    inside an already-provisioned worker (env_vars, working_dir) and
-    rejects the rest explicitly."""
+    """env_vars/working_dir apply inside an already-provisioned
+    worker; pip builds a cached per-node venv whose interpreter runs a
+    dedicated worker (``_private/pip_env.py``). conda/containers are
+    rejected explicitly (no conda or container runtime in scope)."""
     if not runtime_env:
         return None
     unsupported = set(runtime_env) - _SUPPORTED_RUNTIME_ENV_KEYS
@@ -81,7 +81,11 @@ def _validate_runtime_env(runtime_env: Optional[dict]) -> Optional[dict]:
             isinstance(k, str) and isinstance(v, str)
             for k, v in env_vars.items()):
         raise ValueError("runtime_env env_vars must be str -> str")
-    return dict(runtime_env)
+    out = dict(runtime_env)
+    if out.get("pip") is not None:
+        from ray_tpu._private.pip_env import normalize_pip_spec
+        out["pip"] = normalize_pip_spec(out["pip"])   # raises on bad shape
+    return out
 
 
 def _detect_num_tpus() -> int:
@@ -413,14 +417,13 @@ class Worker:
                 for k, v in res.available.items():
                     avail_g.set(v, tags={"node": node, "resource": k})
             head = self.node_group.head_node_id
+            store = self.shm_store.stats()
             heads = {
                 "queued_tasks": len(self.node_group._to_schedule),
                 "running_tasks": len(self.node_group._running),
                 "actors": len(self.node_group._actor_workers),
-                "store_used_bytes":
-                    self.shm_store.stats()["used_bytes"],
-                "store_num_objects":
-                    self.shm_store.stats()["num_objects"],
+                "store_used_bytes": store["used_bytes"],
+                "store_num_objects": store["num_objects"],
             }
             for k, v in heads.items():
                 stat_g.set(float(v),

@@ -54,10 +54,15 @@ class ProcessWorker(BaseWorker):
 
     def __init__(self, session: str, max_inline_bytes: int,
                  hub: ConnectionHub,
-                 on_ready: Callable[["ProcessWorker"], None]):
+                 on_ready: Callable[["ProcessWorker"], None],
+                 python_exe: Optional[str] = None,
+                 env_tag: Optional[str] = None):
         super().__init__()
         self.conn = None
         self._on_ready = on_ready
+        # pip runtime env: exec the venv's interpreter; the pool keeps
+        # such workers in a per-tag idle list for reuse.
+        self.env_tag = env_tag
         token = self.worker_id.hex()
         hub.expect(token, self._register)
         env = dict(os.environ)
@@ -85,7 +90,7 @@ class ProcessWorker(BaseWorker):
         log = open(self.log_path, "ab", buffering=0)
         try:
             self.proc = subprocess.Popen(
-                [sys.executable, entry,
+                [python_exe or sys.executable, entry,
                  "--address", hub.address, "--token", token,
                  "--session", session, "--max-inline",
                  str(max_inline_bytes)],
@@ -199,6 +204,8 @@ class WorkerPool:
         self._max_process = max_process_workers
         self._max_inproc = max_inproc_workers
         self._idle_process: List[ProcessWorker] = []
+        # pip-runtime-env workers, idle, keyed by env tag (venv hash)
+        self._idle_tagged: Dict[str, List[ProcessWorker]] = {}
         self._idle_inproc: List[InProcessWorker] = []
         self._all: Dict[WorkerID, BaseWorker] = {}
         self._lock = threading.RLock()
@@ -212,14 +219,23 @@ class WorkerPool:
     # -- leasing -----------------------------------------------------------
 
     def pop_worker(self, resources: Dict[str, float],
-                   dedicated: bool = False) -> Optional[BaseWorker]:
+                   dedicated: bool = False,
+                   env_tag: Optional[str] = None,
+                   python_exe: Optional[str] = None
+                   ) -> Optional[BaseWorker]:
         """Returns a leased worker, or None (caller re-queues; a newly
-        spawned worker will wake the dispatcher when it registers)."""
+        spawned worker will wake the dispatcher when it registers).
+        ``env_tag``/``python_exe`` lease a pip-runtime-env worker: a
+        process exec'd with the env's interpreter, reused only for the
+        same tag."""
         substrate = self.substrate_for(resources)
         with self._lock:
             self._reap_dead()
-            idle = (self._idle_inproc if substrate == "in_process"
-                    else self._idle_process)
+            if env_tag is not None:
+                idle = self._idle_tagged.setdefault(env_tag, [])
+            else:
+                idle = (self._idle_inproc if substrate == "in_process"
+                        else self._idle_process)
             while idle:
                 w = idle.pop()
                 if w.alive:
@@ -248,14 +264,19 @@ class WorkerPool:
             # Process workers register asynchronously; spawn and let the
             # dispatcher retry when the hub calls back.
             pw = ProcessWorker(self._session, self._max_inline, self._hub,
-                               self._worker_registered)
+                               self._worker_registered,
+                               python_exe=python_exe, env_tag=env_tag)
             self._all[pw.worker_id] = pw
             return None
 
     def _worker_registered(self, worker: ProcessWorker) -> None:
         with self._lock:
             if worker.alive:
-                self._idle_process.append(worker)
+                if worker.env_tag is not None:
+                    self._idle_tagged.setdefault(worker.env_tag,
+                                                 []).append(worker)
+                else:
+                    self._idle_process.append(worker)
         self._on_worker_ready()
 
     def _reap_dead(self) -> None:
@@ -282,6 +303,21 @@ class WorkerPool:
             except Exception:
                 pass
             oldest.kill()
+        # pip-env workers: reap ALL past the idle deadline (no warm
+        # keeper — they still count against the process cap, so idle
+        # tagged workers from many distinct envs would exhaust it).
+        for tag, tagged in list(self._idle_tagged.items()):
+            for w in [w for w in tagged
+                      if now - w.last_idle > max_idle]:
+                tagged.remove(w)
+                self._all.pop(w.worker_id, None)
+                try:
+                    w.send(("shutdown",))
+                except Exception:
+                    pass
+                w.kill()
+            if not tagged:
+                del self._idle_tagged[tag]
 
     def push_worker(self, worker: BaseWorker) -> None:
         with self._lock:
@@ -293,6 +329,9 @@ class WorkerPool:
             worker.last_idle = time.monotonic()
             if worker.kind == "in_process":
                 self._idle_inproc.append(worker)
+            elif getattr(worker, "env_tag", None) is not None:
+                self._idle_tagged.setdefault(worker.env_tag,
+                                             []).append(worker)
             else:
                 self._idle_process.append(worker)
         self._on_worker_ready()
@@ -303,6 +342,9 @@ class WorkerPool:
             self._all.pop(worker.worker_id, None)
             if worker in self._idle_process:
                 self._idle_process.remove(worker)
+            for tagged in self._idle_tagged.values():
+                if worker in tagged:
+                    tagged.remove(worker)
 
     # -- io ----------------------------------------------------------------
 

@@ -207,16 +207,36 @@ def _follow_logs(args) -> int:
     import time as _time
 
     from ray_tpu._private.log_monitor import LogMonitor
-    remote = []
-    if args.address:
-        if getattr(args, "token", ""):
-            from ray_tpu._private import rpc as _rpc
-            _rpc.set_session_token(args.token)
-        remote = _remote_log_sources(args.address)
+    remote_state = {"sources": [], "ts": 0.0}
+
+    def remote_sources():
+        # Re-query the GCS every ~10s: nodes that join (or become
+        # reachable) after the command starts get streamed too.
+        if not args.address:
+            return []
+        now = _time.monotonic()
+        if now - remote_state["ts"] > 10.0:
+            remote_state["ts"] = now
+            try:
+                known = {h for h, _c in remote_state["sources"]}
+                for node_hex, client in _remote_log_sources(
+                        args.address):
+                    if node_hex not in known:
+                        remote_state["sources"].append((node_hex,
+                                                        client))
+            except Exception:
+                pass
+            remote_state["sources"] = [
+                (h, c) for h, c in remote_state["sources"] if c.alive]
+        return remote_state["sources"]
+
+    if args.address and getattr(args, "token", ""):
+        from ray_tpu._private import rpc as _rpc
+        _rpc.set_session_token(args.token)
     pattern = f"/tmp/rtpu_{args.session or ''}*/logs"
     monitor = LogMonitor(
         local_dirs=lambda: glob.glob(pattern),
-        remote_sources=lambda: remote,
+        remote_sources=remote_sources,
         sink=lambda line: print(line, flush=True),
         start=False)
     try:

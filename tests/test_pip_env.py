@@ -1,0 +1,132 @@
+"""pip/venv runtime environments (per-node cached builds).
+
+Reference analog: ``python/ray/_private/runtime_env/pip.py``
+[UNVERIFIED — mount empty, SURVEY.md §0]. Offline-friendly: the test
+installs a tiny LOCAL source package with --no-index, so no network is
+involved; the mechanism (venv build, cache key, dedicated tagged
+workers, failure propagation) is exactly the real path.
+"""
+
+import os
+import shutil
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import pip_env
+
+
+def _make_local_pkg(tmp_path, name: str, value: int) -> str:
+    pkg = tmp_path / name
+    pkg.mkdir()
+    (pkg / "setup.py").write_text(
+        "from setuptools import setup\n"
+        f"setup(name={name!r}, version='1.0', py_modules=[{name!r}])\n")
+    (pkg / f"{name}.py").write_text(f"VALUE = {value}\n")
+    return str(pkg)
+
+
+def _pip_spec(pkg_dir: str) -> dict:
+    return {"packages": [pkg_dir],
+            "pip_install_options": ["--no-index",
+                                    "--no-build-isolation"]}
+
+
+@pytest.fixture
+def cleanup_envs():
+    keys = []
+    yield keys
+    for key in keys:
+        shutil.rmtree(os.path.join("/tmp/rtpu_venvs", key),
+                      ignore_errors=True)
+
+
+def test_pip_env_task_and_cache(ray_start_regular, tmp_path,
+                                cleanup_envs):
+    """A task runs with a package the driver doesn't have; the second
+    use reuses the cached venv (exactly one build)."""
+    pkg_dir = _make_local_pkg(tmp_path, "rtpu_testpkg_a", 123)
+    spec = _pip_spec(pkg_dir)
+    cleanup_envs.append(pip_env.env_key(spec))
+
+    with pytest.raises(ImportError):
+        import rtpu_testpkg_a  # noqa: F401
+
+    @ray_tpu.remote
+    def use_pkg():
+        import rtpu_testpkg_a
+        return rtpu_testpkg_a.VALUE
+
+    ref = use_pkg.options(runtime_env={"pip": spec}).remote()
+    assert ray_tpu.get(ref, timeout=120) == 123
+
+    # second use: cache hit — the build ledger stays at one line
+    ref2 = use_pkg.options(runtime_env={"pip": spec}).remote()
+    assert ray_tpu.get(ref2, timeout=120) == 123
+    builds = os.path.join("/tmp/rtpu_venvs", pip_env.env_key(spec),
+                          ".builds")
+    assert len(open(builds).read().splitlines()) == 1
+
+
+def test_pip_env_actor(ray_start_regular, tmp_path, cleanup_envs):
+    pkg_dir = _make_local_pkg(tmp_path, "rtpu_testpkg_b", 7)
+    spec = _pip_spec(pkg_dir)
+    cleanup_envs.append(pip_env.env_key(spec))
+
+    @ray_tpu.remote
+    class Uses:
+        def __init__(self):
+            import rtpu_testpkg_b
+            self.v = rtpu_testpkg_b.VALUE
+
+        def get(self):
+            return self.v
+
+    a = Uses.options(runtime_env={"pip": spec}).remote()
+    assert ray_tpu.get(a.get.remote(), timeout=120) == 7
+
+
+def test_pip_env_on_remote_raylet(ray_start_cluster, tmp_path,
+                                  cleanup_envs):
+    """The raylet process is the builder for its node (per-node cache,
+    reference architecture)."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"R": 2}, remote=True)
+    pkg_dir = _make_local_pkg(tmp_path, "rtpu_testpkg_c", 55)
+    spec = _pip_spec(pkg_dir)
+    cleanup_envs.append(pip_env.env_key(spec))
+
+    @ray_tpu.remote(resources={"R": 1})
+    def use_pkg():
+        import rtpu_testpkg_c
+        return rtpu_testpkg_c.VALUE
+
+    ref = use_pkg.options(runtime_env={"pip": spec}).remote()
+    assert ray_tpu.get(ref, timeout=180) == 55
+
+
+def test_pip_env_build_failure_fails_task(ray_start_regular,
+                                          cleanup_envs):
+    spec = {"packages": ["definitely-not-a-package-xyz"],
+            "pip_install_options": ["--no-index"]}
+    cleanup_envs.append(pip_env.env_key(spec))
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ref = f.options(runtime_env={"pip": spec}).remote()
+    with pytest.raises(Exception, match="pip"):
+        ray_tpu.get(ref, timeout=120)
+
+
+def test_pip_env_rejects_tpu_demand(ray_start_regular, tmp_path):
+    pkg_dir = _make_local_pkg(tmp_path, "rtpu_testpkg_d", 1)
+
+    @ray_tpu.remote(num_tpus=1)
+    def f():
+        return 1
+
+    ref = f.options(runtime_env={"pip": _pip_spec(pkg_dir)}).remote()
+    with pytest.raises(Exception, match="TPU"):
+        ray_tpu.get(ref, timeout=60)

@@ -2,7 +2,7 @@
 
 Reference analog: ``python/ray/tests/test_runtime_env*.py``
 [UNVERIFIED — mount empty, SURVEY.md §0] — the agent-built pieces
-(pip/conda/containers) are explicitly unsupported; the in-worker
+(conda/containers) are explicitly unsupported; the in-worker
 pieces apply around execution.
 """
 
@@ -53,6 +53,8 @@ def test_unsupported_runtime_env_rejected(ray_start_regular):
         return 1
 
     with pytest.raises(ValueError, match="unsupported runtime_env"):
-        f.options(runtime_env={"pip": ["requests"]}).remote()
+        f.options(runtime_env={"conda": "env"}).remote()
+    with pytest.raises(ValueError, match="pip"):
+        f.options(runtime_env={"pip": {"bogus_key": 1}}).remote()
     with pytest.raises(ValueError, match="str -> str"):
         f.options(runtime_env={"env_vars": {"A": 1}}).remote()
