@@ -1,0 +1,57 @@
+"""Client server: hosts a proxied remote driver for ``rtpu://`` clients.
+
+Reference: ``python/ray/util/client/server/proxier.py`` [UNVERIFIED —
+mount empty, SURVEY.md §0] — a server inside the cluster that remote
+"thin" drivers connect to. Here the server joins the cluster as a
+normal driver (``init(address=GCS)``) and its nested-API surface (the
+same RPC protocol task workers use) IS the client protocol, so clients
+get tasks/actors/objects/PGs/streaming with no second code path.
+Connections are gated by the session token like every other channel.
+
+One embedded driver serves all clients of this server (the reference
+runs one driver per client; run several client-servers for isolation).
+
+    python -m ray_tpu._private.client_server \
+        --address GCS_HOST:PORT --port-file /path
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--address", required=True, help="GCS host:port")
+    p.add_argument("--port-file", required=True)
+    p.add_argument("--config", default="")
+    args = p.parse_args(argv)
+
+    from ray_tpu._private.config import get_config
+    if args.config:
+        get_config().load_serialized(args.config)
+
+    from ray_tpu._private.worker import init, shutdown
+    w = init(address=args.address)
+    host, port = w.node_group.object_server_addr
+    tmp = args.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{host}:{port}")
+    os.replace(tmp, args.port_file)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_a: stop.set())
+    try:
+        while not stop.wait(1.0):
+            pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        shutdown()
+
+
+if __name__ == "__main__":
+    main()

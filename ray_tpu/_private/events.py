@@ -66,7 +66,12 @@ def get_task_events() -> List[dict]:
                 "dur": (e["ts"] - s["ts"]) * 1e6,
                 "pid": 0,
                 "tid": hash(e.get("worker", "")) % 1000,
-                "args": {"state": e["state"]},
+                "args": {"state": e["state"],
+                         # worker-measured execution time (includes
+                         # result serialization, which syncs pending
+                         # device work — the device-time attribution)
+                         **({"exec_ms": e["exec_ms"]}
+                            if "exec_ms" in e else {})},
             })
     return trace
 

@@ -319,3 +319,43 @@ def get_nested_client() -> Optional[NestedClient]:
                 _nested.close()
             _nested = NestedClient(tuple(addr))
         return _nested
+
+
+class ClientWorker(NestedClient):
+    """Proxied remote driver (the Ray Client / ``ray://`` analog,
+    reference ``python/ray/util/client/`` [UNVERIFIED — mount empty,
+    SURVEY.md §0]): a thin client over ONE RPC connection to a
+    client-server's embedded driver. The entire public API rides the
+    same nested-call protocol workers use — submit/get/put/wait,
+    actors, placement groups, streaming generators.
+
+    Difference from the in-worker NestedClient: ``put`` proxies to the
+    driver (the client machine may not be reachable from cluster
+    workers, so client-side object ownership would strand consumers);
+    objects a client puts are driver-owned and pinned until the
+    session ends.
+    """
+
+    def __init__(self, addr):
+        super().__init__(tuple(addr))
+        self.session = f"client-{addr[0]}:{addr[1]}"
+
+    def put(self, value):
+        blob = self.serde.serialize(value).to_bytes()
+        oid_b = self._client.call("nested_put", blob)
+        return ObjectRef(ObjectID(oid_b))
+
+    def _get_function_blob(self, fid: bytes) -> bytes:
+        return self._client.call("nested_function_blob", fid)
+
+    def shutdown(self) -> None:
+        self.close()
+
+
+def parse_client_address(address: str):
+    """'rtpu://host:port' -> (host, port) or None for other schemes."""
+    if not address.startswith("rtpu://"):
+        return None
+    hostport = address[len("rtpu://"):]
+    host, port = hostport.rsplit(":", 1)
+    return (host, int(port))

@@ -549,7 +549,8 @@ class NodeManagerGroup:
                                 contained))
             else:
                 results.append((oid_b, kind, data, contained))
-        self._complete_task(task_id, results, msg.get("error_blob"), sys_err)
+        self._complete_task(task_id, results, msg.get("error_blob"),
+                            sys_err, msg.get("timings"))
 
     def _remote_actor_ready(self, handle: RemoteNodeHandle,
                             msg: dict) -> None:
@@ -998,10 +999,18 @@ class NodeManagerGroup:
 
     def _on_pip_env_requeue(self, parked: list) -> None:
         """A venv build finished (ready or failed): re-queue the specs
-        parked on it; dispatch re-polls and leases or fails them."""
+        parked on it; dispatch re-polls and leases or fails them. A
+        spec whose node died mid-build goes back through scheduling
+        (its allocation was freed with the node)."""
+        rescheduled = []
         with self._lock:
             for raylet, spec in parked:
-                raylet.dispatch_queue.append(spec)
+                if raylet.alive:
+                    raylet.dispatch_queue.append(spec)
+                else:
+                    rescheduled.append(spec)
+        for spec in rescheduled:
+            self.submit_task(spec)
         self._wake.set()
 
     def _dispatch_node(self, raylet: Raylet) -> None:
@@ -1014,31 +1023,23 @@ class NodeManagerGroup:
             env_tag = python_exe = None
             pip_spec = (spec.runtime_env or {}).get("pip")
             if pip_spec is not None:
-                if raylet.worker_pool.substrate_for(
-                        spec.resources) == "in_process":
+                from ray_tpu._private.pip_env import resolve_for_dispatch
+
+                def fail(err, spec=spec, raylet=raylet):
                     self._free_allocation(raylet.node_id, spec.resources,
                                           self._spec_pg(spec))
                     if self._fail_task_cb is not None:
-                        self._fail_task_cb(spec, ValueError(
-                            "pip runtime envs cannot demand TPU: TPU "
-                            "work runs in-process in the host that owns "
-                            "the chips"))
+                        self._fail_task_cb(spec, err)
+
+                # "parked": parked atomically inside the manager until
+                # the venv build finishes (allocation stays held — the
+                # task WILL run here); the requeue callback re-queues.
+                status, env_tag, python_exe = resolve_for_dispatch(
+                    self._pip_envs, pip_spec, spec.resources,
+                    raylet.worker_pool.substrate_for, fail,
+                    park_item=(raylet, spec))
+                if status != "go":
                     continue
-                status, key, detail = self._pip_envs.poll(
-                    pip_spec, park_item=(raylet, spec))
-                if status == "building":
-                    # Parked (atomically, inside poll) until the venv
-                    # build finishes; the requeue callback re-queues us.
-                    # The allocation stays held — the task WILL run here.
-                    continue
-                if status == "failed":
-                    self._free_allocation(raylet.node_id, spec.resources,
-                                          self._spec_pg(spec))
-                    if self._fail_task_cb is not None:
-                        self._fail_task_cb(spec, RuntimeError(
-                            f"runtime_env pip build failed: {detail}"))
-                    continue
-                env_tag, python_exe = key, detail
             worker = raylet.worker_pool.pop_worker(
                 spec.resources, dedicated, env_tag=env_tag,
                 python_exe=python_exe)
@@ -1188,7 +1189,8 @@ class NodeManagerGroup:
                 evt.set()
             return
         if op == "done":
-            _, task_id_b, results, err_blob = reply
+            _, task_id_b, results, err_blob = reply[:4]
+            timings = reply[4] if len(reply) > 4 else None
             task_id = TaskID(task_id_b)
             with self._lock:
                 rt = self._running.pop(task_id, None)
@@ -1201,7 +1203,8 @@ class NodeManagerGroup:
                     raylet.worker_pool.push_worker(worker)
                 self._free_allocation(rt.node_id, rt.resources, rt.pg)
                 self._wake.set()
-            self._complete_task(task_id, results, err_blob, None)
+            self._complete_task(task_id, results, err_blob, None,
+                                timings)
         elif op == "actor_ready":
             _, actor_id_b, err_blob = reply
             task_id = None

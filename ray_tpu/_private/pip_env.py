@@ -123,6 +123,32 @@ def ensure_env(spec) -> str:
 _FAILED_STATE_TTL_S = 30.0
 
 
+def resolve_for_dispatch(manager: "PipEnvManager", pip_spec, resources,
+                         substrate_for, fail, park_item):
+    """The ONE pip-env dispatch gate, shared by the driver's node
+    manager and remote raylets. Returns:
+
+      ("go", env_tag, python_exe)  — lease a tagged worker
+      ("parked", None, None)       — parked inside the manager; a
+                                     requeue event will retry
+      ("failed", None, None)       — ``fail(err)`` was called
+
+    ``fail(exception)`` must complete the work item with an app-level
+    error (no retry)."""
+    if substrate_for(resources or {}) == "in_process":
+        fail(ValueError(
+            "pip runtime envs cannot demand TPU: TPU work runs "
+            "in-process in the host that owns the chips"))
+        return ("failed", None, None)
+    status, key, detail = manager.poll(pip_spec, park_item=park_item)
+    if status == "building":
+        return ("parked", None, None)
+    if status == "failed":
+        fail(RuntimeError(f"runtime_env pip build failed: {detail}"))
+        return ("failed", None, None)
+    return ("go", key, detail)
+
+
 class PipEnvManager:
     """Async build coordinator for a dispatcher: ``poll`` never blocks
     and OWNS the parking of work items waiting on a build (parking and

@@ -224,22 +224,14 @@ class RayletServer:
             env_tag = python_exe = None
             pip_spec = (payload.get("runtime_env") or {}).get("pip")
             if pip_spec is not None:
-                if self.worker_pool.substrate_for(
-                        payload.get("resources") or {}) == "in_process":
-                    self._fail_payload(payload, ValueError(
-                        "pip runtime envs cannot demand TPU: TPU work "
-                        "runs in-process in the host that owns the "
-                        "chips"))
+                from ray_tpu._private.pip_env import resolve_for_dispatch
+                status, env_tag, python_exe = resolve_for_dispatch(
+                    self._pip_envs, pip_spec, payload.get("resources"),
+                    self.worker_pool.substrate_for,
+                    lambda err, p=payload: self._fail_payload(p, err),
+                    park_item=payload)
+                if status != "go":
                     continue
-                status, key, detail = self._pip_envs.poll(
-                    pip_spec, park_item=payload)
-                if status == "building":
-                    continue      # parked atomically inside poll
-                if status == "failed":
-                    self._fail_payload(payload, RuntimeError(
-                        f"runtime_env pip build failed: {detail}"))
-                    continue
-                env_tag, python_exe = key, detail
             worker = self.worker_pool.pop_worker(
                 payload.get("resources") or {"CPU": 1}, dedicated,
                 env_tag=env_tag, python_exe=python_exe)
@@ -397,7 +389,8 @@ class RayletServer:
                                              "results": shipped})
             return
         if op == "done":
-            _, task_id, results, err_blob = reply
+            _, task_id, results, err_blob = reply[:4]
+            timings = reply[4] if len(reply) > 4 else None
             with self._lock:
                 self._running.pop(task_id, None)
                 self._running_demand.pop(task_id, None)
@@ -418,7 +411,8 @@ class RayletServer:
                     shipped.append((oid_b, kind, data, contained))
             self._push_owner("task_done", {
                 "task_id": task_id, "results": shipped,
-                "error_blob": err_blob, "system_error": None})
+                "error_blob": err_blob, "system_error": None,
+                "timings": timings})
         elif op == "actor_ready":
             _, actor_id, err_blob = reply
             with self._lock:

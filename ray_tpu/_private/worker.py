@@ -589,6 +589,8 @@ class Worker:
         s = self.node_group.object_server
         s.register("nested_submit", self._nested_submit)
         s.register("nested_get", self._nested_get)
+        s.register("nested_function_blob",
+                   lambda ctx, fid: self._get_function_blob(fid))
         s.register("nested_put", self._nested_put)
         s.register("nested_wait", self._nested_wait)
         s.register("nested_create_actor", self._nested_create_actor)
@@ -1079,14 +1081,16 @@ class Worker:
             self._store_result(oid, Entry("err", blob))
 
     def _complete_task(self, task_id: TaskID, results, err_blob,
-                       system_error) -> None:
+                       system_error, timings: Optional[dict] = None
+                       ) -> None:
         rec = self.task_manager.get_record(task_id)
         spec = rec.spec if rec else None
         if spec is not None:
             from ray_tpu._private import events
             ok = err_blob is None and system_error is None
             events.record(task_id.hex(), spec.repr_name(),
-                          "FINISHED" if ok else "FAILED")
+                          "FINISHED" if ok else "FAILED",
+                          extra=timings)
         if (spec is not None
                 and spec.task_type == TaskType.ACTOR_CREATION_TASK):
             self._on_actor_creation_done(spec, err_blob, system_error)
@@ -1485,6 +1489,18 @@ def init(**kwargs) -> Worker:
             "ray_tpu API calls inside task/actor workers need an owner "
             "channel and none is attached (workers are pure executors; "
             "nested calls are served by the task's owner).")
+    address = kwargs.get("address")
+    if address and address.startswith("rtpu://"):
+        # Proxied remote driver (Ray Client analog): the whole API
+        # rides one connection to a client-server in the cluster.
+        from ray_tpu._private.nested_client import (ClientWorker,
+                                                    parse_client_address)
+        with _global_lock:
+            if _global_worker is not None:
+                return _global_worker
+            _global_worker = ClientWorker(parse_client_address(address))
+            atexit.register(shutdown)
+            return _global_worker
     with _global_lock:
         if _global_worker is not None:
             return _global_worker

@@ -253,7 +253,10 @@ class WorkerPool:
             limit = (self._max_inproc if substrate == "in_process"
                      else self._max_process)
             if count >= limit:
-                return None
+                if substrate != "process" or \
+                        not self._evict_idle_mismatch(env_tag):
+                    return None
+                # an idle worker of another env was evicted: spawn ours
             if substrate == "in_process":
                 w = InProcessWorker(self._session, self._max_inline,
                                     self._reply_handler)
@@ -268,6 +271,33 @@ class WorkerPool:
                                python_exe=python_exe, env_tag=env_tag)
             self._all[pw.worker_id] = pw
             return None
+
+    def _evict_idle_mismatch(self, want_tag: Optional[str]) -> bool:
+        """At the process cap, kill ONE idle worker whose env doesn't
+        match the requested lease so the cap can admit the right kind
+        (otherwise a pip-env request head-of-line blocks behind idle
+        plain workers, and vice versa). Lock held. Returns True if a
+        slot was freed."""
+        candidates = []
+        for tag, tagged in self._idle_tagged.items():
+            if tag != want_tag:
+                candidates.extend(tagged)
+        if want_tag is not None:
+            candidates.extend(self._idle_process)
+        if not candidates:
+            return False
+        victim = min(candidates, key=lambda w: w.last_idle)
+        for pool in ([self._idle_process]
+                     + list(self._idle_tagged.values())):
+            if victim in pool:
+                pool.remove(victim)
+        self._all.pop(victim.worker_id, None)
+        try:
+            victim.send(("shutdown",))
+        except Exception:
+            pass
+        victim.kill()
+        return True
 
     def _worker_registered(self, worker: ProcessWorker) -> None:
         with self._lock:

@@ -230,7 +230,9 @@ class ExecutionEnv:
         """Run one task payload; returns a ("done", ...) message.
         ``emit`` ships incremental ("stream", ...) messages for
         streaming generator tasks."""
+        import time as _time
         task_id = payload["task_id"]
+        t_start = _time.perf_counter()
         # Expose the owner channel + identity to nested API calls made
         # by the user function (see _private/nested_client.py).
         _CURRENT_TASK.owner_addr = payload.get("owner_addr")
@@ -262,9 +264,14 @@ class ExecutionEnv:
                 if payload["type"] == "exec_actor":
                     instance = self.actors[payload["actor_id"]]
                     method = getattr(instance, payload["method"])
-                    result = method(*args, **kwargs)
+                    call = lambda: method(*args, **kwargs)  # noqa: E731
                 else:
-                    result = fn(*args, **kwargs)
+                    call = lambda: fn(*args, **kwargs)      # noqa: E731
+                # Per-task device-time attribution: inside a jax
+                # profiler capture (util.tracing.start_trace), ops this
+                # task launches appear under its name in the XLA trace.
+                result = self._with_trace_annotation(
+                    payload.get("name", "task"), call)
                 pre_ser = None
                 if payload.get("streaming"):
                     return self._drain_generator(payload, result, emit)
@@ -286,7 +293,11 @@ class ExecutionEnv:
             results = self.store_results(payload["return_ids"], values,
                                          pre_ser=pre_ser if n == 1 else
                                          None)
-            return ("done", task_id, results, None)
+            # exec_ms includes result serialization, which forces any
+            # pending device work — for array-returning TPU tasks this
+            # is wall time INCLUDING device compute.
+            return ("done", task_id, results, None,
+                    {"exec_ms": 1e3 * (_time.perf_counter() - t_start)})
         except BaseException as e:  # noqa: BLE001
             err = TaskError(e, task_repr=payload.get("name", "?"),
                             traceback_str=traceback.format_exc())
@@ -313,7 +324,25 @@ class ExecutionEnv:
                 pass
             if payload["type"] == "create_actor":
                 return ("actor_ready", payload["actor_id"], blob)
-            return ("done", task_id, [], blob)
+            return ("done", task_id, [], blob,
+                    {"exec_ms": 1e3 * (_time.perf_counter() - t_start)})
+
+    @staticmethod
+    def _with_trace_annotation(name: str, call):
+        """Wrap the user call in a jax.profiler.TraceAnnotation when jax
+        is already loaded in this worker — no-op (and no jax import)
+        otherwise."""
+        import sys as _sys
+        if "jax" in _sys.modules:
+            try:
+                from jax.profiler import TraceAnnotation
+            except ImportError:
+                return call()
+            # NOT inside the try: a user ImportError must propagate,
+            # not trigger a silent second execution.
+            with TraceAnnotation(name):
+                return call()
+        return call()
 
     @staticmethod
     def _publish_channels(pubs, blob: bytes, kind: str = "blob") -> None:

@@ -207,7 +207,13 @@ def _follow_logs(args) -> int:
     import time as _time
 
     from ray_tpu._private.log_monitor import LogMonitor
-    remote_state = {"sources": [], "ts": 0.0}
+    if args.address and getattr(args, "token", ""):
+        from ray_tpu._private import rpc as _rpc
+        _rpc.set_session_token(args.token)
+    # Eager first fetch: a bad address/token should ERROR at startup,
+    # not produce a silent empty stream.
+    initial = _remote_log_sources(args.address) if args.address else []
+    remote_state = {"sources": initial, "ts": _time.monotonic()}
 
     def remote_sources():
         # Re-query the GCS every ~10s: nodes that join (or become
@@ -230,9 +236,6 @@ def _follow_logs(args) -> int:
                 (h, c) for h, c in remote_state["sources"] if c.alive]
         return remote_state["sources"]
 
-    if args.address and getattr(args, "token", ""):
-        from ray_tpu._private import rpc as _rpc
-        _rpc.set_session_token(args.token)
     pattern = f"/tmp/rtpu_{args.session or ''}*/logs"
     monitor = LogMonitor(
         local_dirs=lambda: glob.glob(pattern),
@@ -245,6 +248,49 @@ def _follow_logs(args) -> int:
             _time.sleep(0.5)
     except KeyboardInterrupt:
         return 0
+
+
+def _cmd_client_server(args) -> int:
+    """Start a client server: remote drivers connect with
+    ``ray_tpu.init(address="rtpu://HOST:PORT")`` + the session token."""
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    from ray_tpu._private import rpc as _rpc
+    from ray_tpu._private.config import get_config
+    if args.token:
+        _rpc.set_session_token(args.token)
+    d = os.path.join("/tmp", "rtpu_client_server")
+    os.makedirs(d, exist_ok=True)
+    port_file = os.path.join(d, f"cs_{os.getpid()}.addr")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))]
+        + env.get("PYTHONPATH", "").split(os.pathsep))
+    if args.token:
+        env["RTPU_SESSION_TOKEN"] = args.token
+    proc = subprocess.Popen(
+        [_sys.executable, "-m", "ray_tpu._private.client_server",
+         "--address", args.address, "--port-file", port_file,
+         "--config", get_config().serialize()],
+        env=env, start_new_session=True)
+    deadline = _time.monotonic() + 60
+    while _time.monotonic() < deadline:
+        if os.path.exists(port_file):
+            addr = open(port_file).read().strip()
+            print(f"client server started (pid {proc.pid}); connect "
+                  f"remote drivers with "
+                  f"ray_tpu.init(address=\"rtpu://{addr}\")")
+            return 0
+        if proc.poll() is not None:
+            print(f"client server died on startup "
+                  f"(rc={proc.returncode})", file=sys.stderr)
+            return 1
+        _time.sleep(0.05)
+    print("client server did not report its address", file=sys.stderr)
+    return 1
 
 
 def _cmd_workflows(args) -> int:
@@ -288,6 +334,12 @@ def main(argv=None) -> int:
     sp = sub.add_parser("workflows", help="list workflows")
     sp.add_argument("--storage", default=None)
     sp.set_defaults(fn=_cmd_workflows)
+
+    sp = sub.add_parser("client-server",
+                        help="serve proxied remote drivers (rtpu://)")
+    sp.add_argument("--address", required=True, help="GCS host:port")
+    sp.add_argument("--token", default="", help="session token")
+    sp.set_defaults(fn=_cmd_client_server)
 
     sp = sub.add_parser("logs", help="list/tail session daemon logs")
     sp.add_argument("--session", default="")

@@ -1,0 +1,80 @@
+"""Tracing: jax.profiler capture + the task-event timeline.
+
+Reference: ``python/ray/util/tracing/tracing_helper.py`` (opt-in spans
+around submit/execute) and ``ray timeline`` [UNVERIFIED — mount empty,
+SURVEY.md §0]. TPU-native twist (SURVEY §5 row 1): the deep trace is
+the XLA/device trace — ``start_trace``/``stop_trace`` wrap
+``jax.profiler`` in the process that owns the chips, and every task
+executes inside a ``TraceAnnotation`` carrying its name, so device ops
+in the profile attribute to the task that launched them.
+
+Two layers, cheap to expensive:
+
+- **Task timeline** (always on): per-task RUNNING→FINISHED spans with
+  worker-measured ``exec_ms`` (result serialization syncs pending
+  device work, so array-returning TPU tasks' exec_ms includes device
+  compute). ``timeline()`` exports Chrome-trace JSON.
+- **Device profile** (opt-in, heavyweight): ``start_trace(logdir)`` →
+  run the workload → ``stop_trace()``; open the logdir with
+  TensorBoard/XProf or the generated ``.trace.json.gz`` in Perfetto.
+  Task names appear as annotation spans above the XLA ops.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+__all__ = ["start_trace", "stop_trace", "trace", "timeline",
+           "task_events"]
+
+_active = {"logdir": None}
+
+
+def start_trace(logdir: str) -> None:
+    """Begin a jax.profiler capture in THIS process (the TPU owner —
+    in-process tasks and actors are captured; process workers on CPU
+    annotate their own local traces only)."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    _active["logdir"] = logdir
+
+
+def stop_trace() -> Optional[str]:
+    """End the capture; returns the logdir."""
+    import jax
+    jax.profiler.stop_trace()
+    logdir, _active["logdir"] = _active["logdir"], None
+    return logdir
+
+
+class trace:
+    """Context manager: ``with tracing.trace("/tmp/prof"): ...``"""
+
+    def __init__(self, logdir: str):
+        self._logdir = logdir
+
+    def __enter__(self):
+        start_trace(self._logdir)
+        return self
+
+    def __exit__(self, *exc):
+        stop_trace()
+        return False
+
+
+def task_events() -> List[dict]:
+    """Raw task state-transition events (includes per-task exec_ms)."""
+    from ray_tpu._private import events
+    return events.raw_events()
+
+
+def timeline(path: Optional[str] = None) -> List[dict]:
+    """Chrome-trace events for completed tasks; written to ``path``
+    (JSON) when given — load in chrome://tracing or Perfetto."""
+    from ray_tpu._private import events
+    trace_events = events.get_task_events()
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(trace_events, f)
+    return trace_events

@@ -163,3 +163,49 @@ def test_collective_rejects_foreign_backends(ray_start_regular):
         init_collective_group(2, 0, backend="nccl")
     with pytest.raises(ValueError, match="unknown backend"):
         init_collective_group(2, 0, backend="mpi")
+
+
+def test_task_timeline_carries_exec_ms(ray_start_regular):
+    """Per-task device-time attribution: the worker-measured exec_ms
+    rides the done path into the task timeline (process AND in-process
+    workers)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import events as _events
+
+    @ray_tpu.remote
+    def cpu_task():
+        return int(np.arange(10).sum())
+
+    @ray_tpu.remote(num_tpus=1)
+    def tpu_task():
+        import jax.numpy as jnp
+        return float(jnp.arange(8.0).sum())
+
+    assert ray_tpu.get(cpu_task.remote()) == 45
+    assert ray_tpu.get(tpu_task.remote()) == 28.0
+    finished = [e for e in _events.raw_events()
+                if e["state"] == "FINISHED" and "exec_ms" in e]
+    names = {e["name"] for e in finished}
+    assert any("cpu_task" in n for n in names)
+    assert any("tpu_task" in n for n in names)
+    assert all(e["exec_ms"] >= 0 for e in finished)
+    # Chrome-trace export carries it through
+    spans = [t for t in ray_tpu.timeline() if "exec_ms" in t["args"]]
+    assert spans
+
+
+def test_tracing_module_surface(ray_start_regular, tmp_path):
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+    out = tracing.timeline(str(tmp_path / "tl.json"))
+    assert isinstance(out, list)
+    assert (tmp_path / "tl.json").exists()
+    assert tracing.task_events()
