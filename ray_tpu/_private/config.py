@@ -161,6 +161,9 @@ _D("health_check_failure_threshold", int, 5,
 
 # --- logging / events ---
 _D("event_log_enabled", bool, True, "Structured event log to session dir.")
+_D("event_export_enabled", bool, True,
+   "Write JSONL event streams (TASK/ACTOR/NODE) + an end-of-session "
+   "usage_stats.json under the session dir for external collectors.")
 _D("log_level", str, "INFO", "Runtime log level.")
 _D("log_to_driver", bool, True,
    "Stream worker stdout/stderr (local files + remote raylet "

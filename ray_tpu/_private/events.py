@@ -32,14 +32,17 @@ def record(task_id_hex: str, name: str, state: str,
            worker: str = "", extra: Optional[dict] = None) -> None:
     if not get_config().event_log_enabled:
         return
-    _buffer().append({
+    rec = {
         "task_id": task_id_hex,
         "name": name,
         "state": state,
         "worker": worker,
         "ts": time.time(),
         **(extra or {}),
-    })
+    }
+    _buffer().append(rec)
+    from ray_tpu._private import export
+    export.emit("TASK", rec)
 
 
 def raw_events() -> List[dict]:

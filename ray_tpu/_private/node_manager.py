@@ -304,6 +304,9 @@ class NodeManagerGroup:
             self._remote_nodes[node_id] = handle
         self.cluster_resources.add_or_update_node(node_id, resources)
         self._membership_version += 1
+        from ray_tpu._private import export
+        export.emit("NODE", {"event": "ADDED", "node_id": node_id.hex(),
+                             "resources": dict(resources.total)})
         self._wake.set()
         return handle
 
@@ -589,6 +592,9 @@ class NodeManagerGroup:
         self._wake.set()
 
     def _on_remote_node_lost(self, node_id: NodeID) -> None:
+        from ray_tpu._private import export
+        export.emit("NODE", {"event": "REMOVED",
+                             "node_id": node_id.hex()})
         """A raylet process died (connection lost or GCS health). Fail
         its running tasks (they retry on survivors); its objects stay
         recorded and reconstruct lazily on access."""

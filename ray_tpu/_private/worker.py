@@ -234,6 +234,9 @@ class Worker:
         # Per-node agent log plane: tail local worker stdout/stderr
         # files + every remote raylet's read_logs RPC to the driver
         # console (reference: log_monitor.py, log_to_driver).
+        if cfg.event_export_enabled:
+            from ray_tpu._private import export
+            export.start(self.session)
         self._log_monitor = None
         if cfg.log_to_driver:
             from ray_tpu._private.log_monitor import LogMonitor
@@ -1213,6 +1216,9 @@ class Worker:
         actor_id = spec.actor_creation_id
         if err_blob is None and system_error is None:
             self.gcs.update_actor_state(actor_id, "ALIVE")
+            from ray_tpu._private import export
+            export.emit("ACTOR", {"actor_id": actor_id.hex(),
+                                  "state": "ALIVE"})
             self._flush_actor_queues()
         else:
             self.gcs.update_actor_state(actor_id, "DEAD",
@@ -1372,6 +1378,9 @@ class Worker:
         return payload, None
 
     def _on_actor_death(self, actor_id: ActorID) -> None:
+        from ray_tpu._private import export
+        export.emit("ACTOR", {"actor_id": actor_id.hex(),
+                              "state": "WORKER_DIED"})
         with self._actor_lock:
             restarts_left = self._actor_restarts.get(actor_id, 0)
             creation = self._actor_specs.get(actor_id)
@@ -1451,6 +1460,26 @@ class Worker:
                 self.gcs.close()
             except Exception:
                 pass
+        from ray_tpu._private import export as _export
+        try:
+            tm = self.task_manager
+            _export.emit("NODE", {"event": "SESSION_END"})
+            writer = _export.start(self.session) \
+                if get_config().event_export_enabled else None
+            if writer is not None:
+                writer.write_usage_stats({
+                    "session": self.session,
+                    "tasks_finished": tm.num_finished,
+                    "tasks_failed": tm.num_failed,
+                    "task_retries": tm.num_retries,
+                    "reconstructions": tm.num_reconstructions,
+                    "num_nodes": len(list(
+                        self.node_group.cluster_resources.nodes())),
+                    "actors_registered": len(self._actor_specs),
+                })
+        except Exception:
+            pass
+        _export.stop()
         if self._join_address is None:
             # Session owner: sweep shm orphans left by killed workers.
             from ray_tpu._private.object_store import (

@@ -1,0 +1,65 @@
+"""Event export pipeline + usage summary.
+
+Reference analogs: ``src/ray/util/event.cc`` structured event files,
+the export-API JSONL streams, and ``usage_lib`` [UNVERIFIED — mount
+empty, SURVEY.md §0]. Zero-egress: everything is local files.
+"""
+
+import json
+import os
+
+import ray_tpu
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_event_export_and_usage_stats():
+    ray_tpu.shutdown()
+    w = ray_tpu.init(num_cpus=4, max_process_workers=2)
+    export_dir = os.path.join("/tmp", f"rtpu_{w.session}", "export")
+
+    @ray_tpu.remote
+    def work(x):
+        return x * 2
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    assert ray_tpu.get([work.remote(i) for i in range(3)]) == [0, 2, 4]
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    session = w.session
+    ray_tpu.shutdown()     # flushes the export buffers
+
+    task_events = _read_jsonl(os.path.join(export_dir,
+                                           "event_TASK.jsonl"))
+    finished = [e for e in task_events if e["state"] == "FINISHED"]
+    assert any("work" in e["name"] for e in finished)
+    assert all("ts" in e for e in task_events)
+
+    actor_events = _read_jsonl(os.path.join(export_dir,
+                                            "event_ACTOR.jsonl"))
+    assert any(e["state"] == "ALIVE" for e in actor_events)
+
+    usage = json.load(open(os.path.join(export_dir,
+                                        "usage_stats.json")))
+    assert usage["session"] == session
+    assert usage["tasks_finished"] >= 4
+    assert usage["actors_registered"] >= 1
+
+
+def test_node_membership_export(ray_start_cluster):
+    cluster = ray_start_cluster
+    w = cluster._worker
+    export_dir = os.path.join("/tmp", f"rtpu_{w.session}", "export")
+    node_id = cluster.add_node(num_cpus=1, remote=True)
+    from ray_tpu._private import export
+    export._writer.flush()
+    events = _read_jsonl(os.path.join(export_dir, "event_NODE.jsonl"))
+    assert any(e.get("event") == "ADDED"
+               and e.get("node_id") == node_id.hex() for e in events)
