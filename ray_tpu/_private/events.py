@@ -30,8 +30,8 @@ def _buffer() -> Deque:
 
 def record(task_id_hex: str, name: str, state: str,
            worker: str = "", extra: Optional[dict] = None) -> None:
-    if not get_config().event_log_enabled:
-        return
+    """Ring buffer (event_log_enabled) and JSONL export
+    (event_export_enabled) gate INDEPENDENTLY."""
     rec = {
         "task_id": task_id_hex,
         "name": name,
@@ -40,7 +40,8 @@ def record(task_id_hex: str, name: str, state: str,
         "ts": time.time(),
         **(extra or {}),
     }
-    _buffer().append(rec)
+    if get_config().event_log_enabled:
+        _buffer().append(rec)
     from ray_tpu._private import export
     export.emit("TASK", rec)
 

@@ -9,7 +9,7 @@ files; nothing is ever sent anywhere by this runtime.
 
 Layout (``/tmp/rtpu_<session>/export/``):
   event_TASK.jsonl    one record per task state transition
-  event_ACTOR.jsonl   actor lifecycle (REGISTERED/ALIVE/DEAD/RESTART)
+  event_ACTOR.jsonl   actor lifecycle (REGISTERED/ALIVE/RESTARTING/DEAD)
   event_NODE.jsonl    node membership (ADDED/REMOVED)
   usage_stats.json    end-of-session counters (written at shutdown)
 """
@@ -69,6 +69,9 @@ class ExportWriter:
 
     def stop(self) -> None:
         self._stop.set()
+        # Join before the final flush: a concurrent loop-thread flush
+        # would interleave partial lines in the same append-mode file.
+        self._thread.join(timeout=5.0)
         self.flush()
 
 

@@ -256,6 +256,9 @@ class NodeManagerGroup:
             self._raylets[node_id] = raylet
         self.cluster_resources.add_or_update_node(node_id, resources)
         self._membership_version += 1
+        from ray_tpu._private import export
+        export.emit("NODE", {"event": "ADDED", "node_id": node_id.hex(),
+                             "resources": dict(resources.total)})
         self._wake.set()
         return raylet
 
@@ -592,12 +595,12 @@ class NodeManagerGroup:
         self._wake.set()
 
     def _on_remote_node_lost(self, node_id: NodeID) -> None:
-        from ray_tpu._private import export
-        export.emit("NODE", {"event": "REMOVED",
-                             "node_id": node_id.hex()})
         """A raylet process died (connection lost or GCS health). Fail
         its running tasks (they retry on survivors); its objects stay
         recorded and reconstruct lazily on access."""
+        from ray_tpu._private import export
+        export.emit("NODE", {"event": "REMOVED",
+                             "node_id": node_id.hex()})
         with self._lock:
             handle = self._remote_nodes.pop(node_id, None)
             if handle is None:

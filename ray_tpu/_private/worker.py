@@ -129,6 +129,12 @@ class Worker:
         if self._join_address is None:
             _rpc.ensure_session_token(self.session)
 
+        # Exporter first: node/actor lifecycle events fire during the
+        # rest of construction (head-node ADDED would otherwise vanish).
+        if cfg.event_export_enabled:
+            from ray_tpu._private import export
+            export.start(self.session)
+
         self.serde = serialization.get_context()
         self.memory_store = MemoryStore()
         self.shm_store = ShmStore(
@@ -234,9 +240,6 @@ class Worker:
         # Per-node agent log plane: tail local worker stdout/stderr
         # files + every remote raylet's read_logs RPC to the driver
         # console (reference: log_monitor.py, log_to_driver).
-        if cfg.event_export_enabled:
-            from ray_tpu._private import export
-            export.start(self.session)
         self._log_monitor = None
         if cfg.log_to_driver:
             from ray_tpu._private.log_monitor import LogMonitor
@@ -1202,6 +1205,10 @@ class Worker:
             max_restarts=max_restarts,
             creation_spec=spec, class_name=class_name)
         self.gcs.register_actor(info)
+        from ray_tpu._private import export
+        export.emit("ACTOR", {"actor_id": actor_id.hex(),
+                              "state": "REGISTERED",
+                              "class_name": class_name})
         with self._actor_lock:
             self._actor_queues[actor_id] = deque()
             self._actor_seq[actor_id] = 0
@@ -1223,6 +1230,10 @@ class Worker:
         else:
             self.gcs.update_actor_state(actor_id, "DEAD",
                                         death_cause="creation failed")
+            from ray_tpu._private import export
+            export.emit("ACTOR", {"actor_id": actor_id.hex(),
+                                  "state": "DEAD",
+                                  "cause": "creation failed"})
             self._fail_actor_queue(actor_id, err_blob)
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
@@ -1379,8 +1390,6 @@ class Worker:
 
     def _on_actor_death(self, actor_id: ActorID) -> None:
         from ray_tpu._private import export
-        export.emit("ACTOR", {"actor_id": actor_id.hex(),
-                              "state": "WORKER_DIED"})
         with self._actor_lock:
             restarts_left = self._actor_restarts.get(actor_id, 0)
             creation = self._actor_specs.get(actor_id)
@@ -1390,6 +1399,8 @@ class Worker:
                 with self._actor_lock:
                     self._actor_restarts[actor_id] = restarts_left - 1
             self.gcs.update_actor_state(actor_id, "RESTARTING")
+            export.emit("ACTOR", {"actor_id": actor_id.hex(),
+                                  "state": "RESTARTING"})
             if info:
                 info.num_restarts += 1
             self.task_manager.add_pending_task(creation)
@@ -1397,6 +1408,9 @@ class Worker:
         else:
             self.gcs.update_actor_state(actor_id, "DEAD",
                                         death_cause="worker died")
+            export.emit("ACTOR", {"actor_id": actor_id.hex(),
+                                  "state": "DEAD",
+                                  "cause": "worker died"})
             self._fail_actor_queue(actor_id, None)
 
     def _fail_actor_queue(self, actor_id: ActorID,
@@ -1418,6 +1432,9 @@ class Worker:
             self._actor_restarts[actor_id] = 0
         self.node_group.release_actor(actor_id, kill_worker=True)
         self.gcs.update_actor_state(actor_id, "DEAD", death_cause="killed")
+        from ray_tpu._private import export
+        export.emit("ACTOR", {"actor_id": actor_id.hex(),
+                              "state": "DEAD", "cause": "killed"})
         self._fail_actor_queue(actor_id, None)
 
     # ------------------------------------------------------------------

@@ -44,7 +44,12 @@ def test_event_export_and_usage_stats():
 
     actor_events = _read_jsonl(os.path.join(export_dir,
                                             "event_ACTOR.jsonl"))
-    assert any(e["state"] == "ALIVE" for e in actor_events)
+    states = {e["state"] for e in actor_events}
+    assert {"REGISTERED", "ALIVE"} <= states
+
+    node_events = _read_jsonl(os.path.join(export_dir,
+                                           "event_NODE.jsonl"))
+    assert any(e.get("event") == "ADDED" for e in node_events)
 
     usage = json.load(open(os.path.join(export_dir,
                                         "usage_stats.json")))
