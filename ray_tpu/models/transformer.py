@@ -43,6 +43,11 @@ class TransformerConfig:
     rope_theta: float = 10_000.0
     dtype: Any = jnp.bfloat16    # compute dtype
     remat: bool = True
+    # Pallas flash attention (ops/flash_attention.py): fused blockwise
+    # kernel, no S×S in HBM — the TPU fast path (1.8x over dense at
+    # seq 4096 on v5e). Off by default: CPU tests run the interpret
+    # path, which is slower than dense XLA.
+    use_flash: bool = False
     use_moe: bool = False
     n_experts: int = 8
     expert_top_k: int = 2
@@ -224,6 +229,10 @@ def forward(params, tokens: jax.Array, cfg: TransformerConfig,
             jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :],
             tokens.shape)
     x = params["embed"].astype(cfg.dtype)[tokens]
+    if attn_fn is None and cfg.use_flash:
+        from ray_tpu.ops.flash_attention import flash_attention
+        attn_fn = lambda q, k, v, causal=True: flash_attention(  # noqa: E731
+            q, k, v, causal=causal)
     blk = functools.partial(_block_forward, cfg=cfg, attn_fn=attn_fn)
     if cfg.remat:
         blk = jax.checkpoint(blk, static_argnums=())

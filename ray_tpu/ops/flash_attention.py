@@ -11,7 +11,15 @@ KV streamed through VMEM in blocks, saving only (O, LSE) residuals);
 backward is a Pallas FlashAttention-2 backward — blockwise dq/dk/dv
 recomputed from (O, LSE), so no S×S probability matrix ever touches
 HBM in either direction. Gradients are exact (grad-checked against the
-dense reference in tests/test_attention.py).
+dense reference in tests/test_attention.py, on real TPU lowering too).
+
+TPU alignment (Mosaic): dynamic VMEM loads must sit at provably
+8-aligned rows and block shapes must tile to (8, 128), so sequences
+are PADDED to block multiples outside the kernels and padded rows are
+masked by the true lengths — no data-dependent clamping inside the
+kernel (a clamped start index cannot be statically proven aligned),
+and the LSE/delta vectors carry a singleton middle axis so their
+blocks satisfy the tiling rule.
 
 Layout everywhere: [B, S, N, H].
 """
@@ -46,14 +54,38 @@ def mha_reference(q, k, v, *, causal: bool = True,
     return jnp.einsum("bnqk,bknh->bqnh", probs.astype(v.dtype), v)
 
 
+def _pad_seq(x, block: int):
+    """Pad axis 1 ([BN, S, H]) up to a multiple of ``block``."""
+    pad = (-x.shape[1]) % block
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+
+
+_LANE = 128
+
+
+def _pad_head(x):
+    """Pad the head dim ([BN, S, H]) to a lane multiple: Mosaic slices
+    inside the kernel must be 128-aligned along lanes. Zero lanes are
+    inert — q·kᵀ and p·v are unchanged, and their output/grad columns
+    are zero (sliced away)."""
+    pad = (-x.shape[2]) % _LANE
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad)))
+
+
 # --------------------------------------------------------------------------
 # Pallas forward kernel
 # --------------------------------------------------------------------------
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                      causal: bool, sm_scale: float, block_k: int):
-    # q_ref: [block_q, H]; k_ref/v_ref: [S_k, H]; o_ref: [block_q, H];
-    # lse_ref: [block_q] log-sum-exp residual for the flash backward.
+                      causal: bool, sm_scale: float, block_k: int,
+                      true_sk: int):
+    # q_ref: [block_q, H]; k_ref/v_ref: [S_k_padded, H];
+    # o_ref: [block_q, H]; lse_ref: [1, block_q].
+    # ``true_sk`` masks KV rows that exist only as block padding.
     block_q, head_dim = q_ref.shape
     seq_k = k_ref.shape[0]
     qi = pl.program_id(1)
@@ -62,24 +94,20 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
 
-    n_kv = pl.cdiv(seq_k, block_k)
+    n_kv = seq_k // block_k
 
     def body(j, carry):
         o, m, l = carry
-        # pl.ds clamps the start when the final block would run past
-        # seq_k, re-reading earlier KV rows. Label positions from the
-        # CLAMPED start and mask rows already covered by prior blocks,
-        # so seq lengths not divisible by block_k stay exact.
-        start = jnp.minimum(j * block_k, seq_k - block_k)
+        start = pl.multiple_of(j * block_k, block_k)
         k_blk = k_ref[pl.ds(start, block_k), :]
         v_blk = v_ref[pl.ds(start, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk.astype(jnp.float32),
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)      # [block_q, block_k]
-        k_pos = start + jax.lax.broadcasted_iota(
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        mask = k_pos >= j * block_k
+        mask = k_pos < true_sk
         if causal:
             mask = mask & (q_pos >= k_pos)
         s = jnp.where(mask, s, -1e30)
@@ -106,40 +134,69 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     o, m, l = jax.lax.fori_loop(0, n_iter, body, (o, m, l))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[:] = (o / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = m + jnp.log(l_safe)
+    lse_ref[0, :] = m + jnp.log(l_safe)
+
+
+def _check_blocks(block_q: int, block_k: int, sqp: int,
+                  interpret: bool) -> None:
+    """Compiled-lowering constraints (interpret mode has no tiling):
+    in-kernel dynamic-slice starts (j·block) must be provably
+    8-aligned, and the LSE block's lane dim (block_q) must divide 128
+    unless it covers the whole padded sequence. Blocks are NEVER
+    shrunk to the sequence length — a non-tile seq would break the
+    alignment proof; short sequences pad up to one block instead."""
+    if interpret:
+        return
+    if block_q % 8 or block_k % 8:
+        raise ValueError(
+            f"flash attention blocks must be multiples of 8 for TPU "
+            f"lowering, got ({block_q}, {block_k})")
+    if sqp != block_q and block_q % 128:
+        raise ValueError(
+            f"block_q={block_q} must be a multiple of 128 (or cover "
+            f"the whole padded sequence {sqp}) for TPU lowering")
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     b, s_q, n, h = q.shape
     s_k = k.shape[1]
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_k)
-    # fold batch and heads into the grid; [BN, S, H] layout per head
-    qt = q.transpose(0, 2, 1, 3).reshape(b * n, s_q, h)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * n, s_k, h)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * n, s_k, h)
-    grid = (b * n, pl.cdiv(s_q, block_q))
+    # fold batch and heads into the grid; [BN, S, H] layout per head;
+    # pad sequences to block multiples (masked by true lengths inside)
+    qt = _pad_head(_pad_seq(
+        q.transpose(0, 2, 1, 3).reshape(b * n, s_q, h), block_q))
+    kt = _pad_head(_pad_seq(
+        k.transpose(0, 2, 1, 3).reshape(b * n, s_k, h), block_k))
+    vt = _pad_head(_pad_seq(
+        v.transpose(0, 2, 1, 3).reshape(b * n, s_k, h), block_k))
+    sqp, skp, hp = qt.shape[1], kt.shape[1], qt.shape[2]
+    _check_blocks(block_q, block_k, sqp, interpret)
+    grid = (b * n, sqp // block_q)
     kernel = functools.partial(_flash_fwd_kernel, causal=causal,
-                               sm_scale=sm_scale, block_k=block_k)
+                               sm_scale=sm_scale, block_k=block_k,
+                               true_sk=s_k)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, h), lambda bn, i: (bn, i, 0)),
-            pl.BlockSpec((1, s_k, h), lambda bn, i: (bn, 0, 0)),
-            pl.BlockSpec((1, s_k, h), lambda bn, i: (bn, 0, 0)),
+            pl.BlockSpec((1, block_q, hp), lambda bn, i: (bn, i, 0)),
+            pl.BlockSpec((1, skp, hp), lambda bn, i: (bn, 0, 0)),
+            pl.BlockSpec((1, skp, hp), lambda bn, i: (bn, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, h), lambda bn, i: (bn, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bn, i: (bn, i)),
+            pl.BlockSpec((1, block_q, hp), lambda bn, i: (bn, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bn, i: (bn, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * n, s_q, h), q.dtype),
-            jax.ShapeDtypeStruct((b * n, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((b * n, sqp, hp), q.dtype),
+            jax.ShapeDtypeStruct((b * n, 1, sqp), jnp.float32),
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, n, s_q, h).transpose(0, 2, 1, 3), lse
+    out = out[:, :s_q, :h].reshape(b, n, s_q, h).transpose(0, 2, 1, 3)
+    # lse stays PADDED [BN, sqp]: the only consumer (_flash_bwd, same
+    # block sizes) needs it padded anyway — slicing here would just be
+    # re-padded there.
+    return out, lse.reshape(b * n, sqp)
 
 
 # Pallas BlockSpec blocks carry the leading singleton; squeeze inside.
@@ -169,30 +226,30 @@ _flash_fwd_kernel = _squeeze_kernel(_flash_fwd_kernel)
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, causal: bool, sm_scale: float,
-                         block_k: int):
-    # q/do/dq: [block_q, H]; k/v: [S_k, H]; lse/delta: [block_q]
+                         block_k: int, true_sk: int):
+    # q/do/dq: [block_q, H]; k/v: [S_k_padded, H]; lse/delta: [1, block_q]
     block_q, head_dim = q_ref.shape
     seq_k = k_ref.shape[0]
     qi = pl.program_id(1)
 
     q = q_ref[:].astype(jnp.float32)
     do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:]
-    delta = delta_ref[:]
+    lse = lse_ref[0, :]
+    delta = delta_ref[0, :]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
-    n_kv = pl.cdiv(seq_k, block_k)
+    n_kv = seq_k // block_k
 
     def body(j, dq):
-        start = jnp.minimum(j * block_k, seq_k - block_k)
+        start = pl.multiple_of(j * block_k, block_k)
         k_blk = k_ref[pl.ds(start, block_k), :].astype(jnp.float32)
         v_blk = v_ref[pl.ds(start, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
-        k_pos = start + jax.lax.broadcasted_iota(
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        mask = k_pos >= j * block_k        # clamped-tail de-dup
+        mask = k_pos < true_sk
         if causal:
             mask = mask & (q_pos >= k_pos)
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
@@ -215,8 +272,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, causal: bool, sm_scale: float,
-                          block_q: int):
-    # k/v/dk/dv: [block_k, H]; q/do: [S_q, H]; lse/delta: [S_q]
+                          block_q: int, true_sq: int):
+    # k/v/dk/dv: [block_k, H]; q/do: [S_q_padded, H]; lse/delta: [1, S_q]
     block_k, head_dim = k_ref.shape
     seq_q = q_ref.shape[0]
     ki = pl.program_id(1)
@@ -225,21 +282,21 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v = v_ref[:].astype(jnp.float32)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    n_q = pl.cdiv(seq_q, block_q)
+    n_q = seq_q // block_q
 
     def body(i, carry):
         dk, dv = carry
-        start = jnp.minimum(i * block_q, seq_q - block_q)
+        start = pl.multiple_of(i * block_q, block_q)
         q_blk = q_ref[pl.ds(start, block_q), :].astype(jnp.float32)
         do_blk = do_ref[pl.ds(start, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[pl.ds(start, block_q)]
-        delta_blk = delta_ref[pl.ds(start, block_q)]
+        lse_blk = lse_ref[0, pl.ds(start, block_q)]
+        delta_blk = delta_ref[0, pl.ds(start, block_q)]
         s = jax.lax.dot_general(
             q_blk, k, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
-        q_pos = start + jax.lax.broadcasted_iota(
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        mask = q_pos >= i * block_q        # clamped-tail de-dup
+        mask = q_pos < true_sq          # padded query rows contribute 0
         if causal:
             mask = mask & (q_pos >= k_pos)
         p = jnp.where(mask, jnp.exp(s - lse_blk[:, None]), 0.0)
@@ -276,60 +333,75 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k,
                interpret):
     b, s_q, n, h = q.shape
     s_k = k.shape[1]
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_k)
-    qt = q.transpose(0, 2, 1, 3).reshape(b * n, s_q, h)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * n, s_k, h)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * n, s_k, h)
-    dot = g.transpose(0, 2, 1, 3).reshape(b * n, s_q, h)
+    qt = _pad_head(_pad_seq(
+        q.transpose(0, 2, 1, 3).reshape(b * n, s_q, h), block_q))
+    kt = _pad_head(_pad_seq(
+        k.transpose(0, 2, 1, 3).reshape(b * n, s_k, h), block_k))
+    vt = _pad_head(_pad_seq(
+        v.transpose(0, 2, 1, 3).reshape(b * n, s_k, h), block_k))
+    dot = _pad_head(_pad_seq(
+        g.transpose(0, 2, 1, 3).reshape(b * n, s_q, h), block_q))
+    ot = _pad_head(_pad_seq(
+        out.transpose(0, 2, 1, 3).reshape(b * n, s_q, h), block_q))
+    sqp, skp, hp = qt.shape[1], kt.shape[1], qt.shape[2]
+    _check_blocks(block_q, block_k, sqp, interpret)
     # delta = rowsum(dO ∘ O): cheap elementwise outside the kernels
-    delta = jnp.sum(dot.astype(jnp.float32)
-                    * out.transpose(0, 2, 1, 3).reshape(b * n, s_q, h)
-                    .astype(jnp.float32), axis=-1)          # [BN, S_q]
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1)                              # [BN, S_q_pad]
+    # Singleton middle axis: TPU blocks over the last two dims must
+    # divide (8, 128) or equal the array dims — (1, block) over a 2-D
+    # (BN, S) array does neither. lse arrives already padded to sqp
+    # (same block sizes as the forward).
+    assert lse.shape == (b * n, sqp), (lse.shape, sqp)
+    lse3 = lse.reshape(b * n, 1, sqp)
+    delta3 = delta.reshape(b * n, 1, sqp)
 
     dq_kernel = functools.partial(_flash_bwd_dq_kernel, causal=causal,
-                                  sm_scale=sm_scale, block_k=block_k)
+                                  sm_scale=sm_scale, block_k=block_k,
+                                  true_sk=s_k)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(b * n, pl.cdiv(s_q, block_q)),
+        grid=(b * n, sqp // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, h), lambda bn, i: (bn, i, 0)),
-            pl.BlockSpec((1, s_k, h), lambda bn, i: (bn, 0, 0)),
-            pl.BlockSpec((1, s_k, h), lambda bn, i: (bn, 0, 0)),
-            pl.BlockSpec((1, block_q, h), lambda bn, i: (bn, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bn, i: (bn, i)),
-            pl.BlockSpec((1, block_q), lambda bn, i: (bn, i)),
+            pl.BlockSpec((1, block_q, hp), lambda bn, i: (bn, i, 0)),
+            pl.BlockSpec((1, skp, hp), lambda bn, i: (bn, 0, 0)),
+            pl.BlockSpec((1, skp, hp), lambda bn, i: (bn, 0, 0)),
+            pl.BlockSpec((1, block_q, hp), lambda bn, i: (bn, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bn, i: (bn, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda bn, i: (bn, 0, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, h), lambda bn, i: (bn, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * n, s_q, h), q.dtype),
+        out_specs=pl.BlockSpec((1, block_q, hp), lambda bn, i: (bn, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * n, sqp, hp), q.dtype),
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, lse3, delta3)
 
     dkv_kernel = functools.partial(_flash_bwd_dkv_kernel, causal=causal,
-                                   sm_scale=sm_scale, block_q=block_q)
+                                   sm_scale=sm_scale, block_q=block_q,
+                                   true_sq=s_q)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b * n, pl.cdiv(s_k, block_k)),
+        grid=(b * n, skp // block_k),
         in_specs=[
-            pl.BlockSpec((1, s_q, h), lambda bn, j: (bn, 0, 0)),
-            pl.BlockSpec((1, block_k, h), lambda bn, j: (bn, j, 0)),
-            pl.BlockSpec((1, block_k, h), lambda bn, j: (bn, j, 0)),
-            pl.BlockSpec((1, s_q, h), lambda bn, j: (bn, 0, 0)),
-            pl.BlockSpec((1, s_q), lambda bn, j: (bn, 0)),
-            pl.BlockSpec((1, s_q), lambda bn, j: (bn, 0)),
+            pl.BlockSpec((1, sqp, hp), lambda bn, j: (bn, 0, 0)),
+            pl.BlockSpec((1, block_k, hp), lambda bn, j: (bn, j, 0)),
+            pl.BlockSpec((1, block_k, hp), lambda bn, j: (bn, j, 0)),
+            pl.BlockSpec((1, sqp, hp), lambda bn, j: (bn, 0, 0)),
+            pl.BlockSpec((1, 1, sqp), lambda bn, j: (bn, 0, 0)),
+            pl.BlockSpec((1, 1, sqp), lambda bn, j: (bn, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, h), lambda bn, j: (bn, j, 0)),
-            pl.BlockSpec((1, block_k, h), lambda bn, j: (bn, j, 0)),
+            pl.BlockSpec((1, block_k, hp), lambda bn, j: (bn, j, 0)),
+            pl.BlockSpec((1, block_k, hp), lambda bn, j: (bn, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * n, s_k, h), k.dtype),
-            jax.ShapeDtypeStruct((b * n, s_k, h), v.dtype),
+            jax.ShapeDtypeStruct((b * n, skp, hp), k.dtype),
+            jax.ShapeDtypeStruct((b * n, skp, hp), v.dtype),
         ],
         interpret=interpret,
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, lse3, delta3)
 
-    unfold = lambda x, s: x.reshape(b, n, s, h).transpose(0, 2, 1, 3)
+    unfold = lambda x, s: x[:, :s, :h].reshape(b, n, s, h).transpose(
+        0, 2, 1, 3)
     return unfold(dq, s_q), unfold(dk, s_k), unfold(dv, s_k)
 
 

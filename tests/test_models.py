@@ -87,3 +87,27 @@ def test_graft_entry_hooks():
     out = jax.jit(fn)(*args)
     assert out.shape[0] == 2
     ge.dryrun_multichip(8)
+
+
+def test_use_flash_matches_dense_forward():
+    """cfg.use_flash routes attention through the Pallas kernel; logits
+    match the dense path."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import TransformerConfig, init_params
+    from ray_tpu.models.transformer import forward
+
+    # f32 compute isolates algorithmic equality from bf16
+    # rounding-order differences (flash keeps P in f32 for the PV
+    # accumulate; dense casts probs to bf16 first).
+    base = dict(vocab_size=128, d_model=64, n_layers=2, n_heads=2,
+                n_kv_heads=2, d_ff=128, max_seq_len=128,
+                dtype=jnp.float32)
+    cfg_d = TransformerConfig(**base)
+    cfg_f = TransformerConfig(**base, use_flash=True)
+    params = init_params(jax.random.PRNGKey(0), cfg_d)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 128), 0, 128)
+    out_d = forward(params, tokens, cfg_d)
+    out_f = forward(params, tokens, cfg_f)
+    assert float(jnp.max(jnp.abs(out_d - out_f))) < 2e-2
