@@ -833,6 +833,10 @@ class NodeManagerGroup:
         while not self._shutdown:
             self._wake.wait(timeout=0.1)
             self._wake.clear()
+            if self._shutdown:
+                # the wake that ended the wait was shutdown's — don't
+                # run (and possibly jit-compile in) one more body
+                break
             try:
                 # Membership changed since tasks were parked infeasible:
                 # a new node may satisfy them now.

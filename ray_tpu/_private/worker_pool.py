@@ -412,13 +412,23 @@ class WorkerPool:
             self._all.clear()
             self._idle_process.clear()
             self._idle_inproc.clear()
+            self._idle_tagged.clear()
+        graceful = []
         for w in workers:
+            if isinstance(w, ProcessWorker) and w.conn is None:
+                # Never registered (still booting): the shutdown message
+                # has no channel to ride — kill outright instead of
+                # waiting out the grace period for a worker that never
+                # had work.
+                w.kill()
+                continue
             try:
                 w.send(("shutdown",))
+                graceful.append(w)
             except Exception:
-                pass
+                w.kill()
         deadline = time.monotonic() + 2.0
-        for w in workers:
+        for w in graceful:
             if isinstance(w, ProcessWorker):
                 try:
                     w.proc.wait(max(0.05, deadline - time.monotonic()))

@@ -1,12 +1,14 @@
-"""Dashboard-lite: HTTP view over the state API + metrics.
+"""Dashboard: HTTP view over the state API + metrics, with a
+single-file UI.
 
 Reference: ``python/ray/dashboard/`` (aiohttp head + React SPA)
 [UNVERIFIED — mount empty, SURVEY.md §0]. The aggregation layer is
 what matters architecturally — GCS + scheduler + store state behind
-HTTP — so this serves the state API as JSON plus the Prometheus
-endpoint and a minimal HTML overview, in the driver process:
+HTTP. The UI is deliberately a build-less single HTML file (tabbed
+tables over the JSON APIs, auto-refresh, zero dependencies) rather
+than a React bundle: same information surface, no toolchain.
 
-  GET /                 HTML overview (auto-refreshing)
+  GET /                 tabbed UI (summary/nodes/actors/tasks/...)
   GET /api/summary      cluster summary
   GET /api/nodes|actors|tasks|objects|workers
   GET /metrics          Prometheus exposition
@@ -21,23 +23,67 @@ from typing import Optional, Tuple
 
 _PAGE = """<!doctype html>
 <html><head><title>ray_tpu dashboard</title>
-<meta http-equiv="refresh" content="5">
-<style>body{font-family:monospace;margin:2em}table{border-collapse:
-collapse}td,th{border:1px solid #999;padding:4px 8px;text-align:left}
-h2{margin-top:1.2em}</style></head><body>
-<h1>ray_tpu</h1><div id="content">%s</div></body></html>"""
-
-
-def _table(rows) -> str:
-    if not rows:
-        return "<p>none</p>"
-    cols = list(rows[0].keys())
-    out = ["<table><tr>"] + [f"<th>{c}</th>" for c in cols] + ["</tr>"]
-    for r in rows:
-        out.append("<tr>" + "".join(
-            f"<td>{r.get(c, '')}</td>" for c in cols) + "</tr>")
-    out.append("</table>")
-    return "".join(out)
+<style>
+ body{font-family:ui-monospace,monospace;margin:1.5em;background:#fafafa}
+ h1{font-size:1.3em} .mut{color:#777}
+ nav button{font:inherit;margin-right:.4em;padding:.3em .8em;border:1px
+  solid #bbb;background:#fff;cursor:pointer;border-radius:4px}
+ nav button.on{background:#2a6df4;color:#fff;border-color:#2a6df4}
+ table{border-collapse:collapse;margin-top:1em;background:#fff}
+ td,th{border:1px solid #ccc;padding:4px 8px;text-align:left;
+  font-size:.85em;max-width:28em;overflow:hidden;text-overflow:ellipsis}
+ th{background:#eee} pre{background:#fff;border:1px solid #ccc;
+  padding:1em;display:inline-block;min-width:24em}
+</style></head><body>
+<h1>ray_tpu <span class="mut" id="refreshed"></span></h1>
+<nav id="nav"></nav><div id="content">summary loading…</div>
+<p class="mut"><a href="/metrics">/metrics</a> (Prometheus)</p>
+<script>
+const TABS = ["summary","nodes","actors","tasks","objects","workers"];
+let tab = location.hash.slice(1) || "summary";
+const nav = document.getElementById("nav");
+TABS.forEach(t => {
+  const b = document.createElement("button");
+  b.textContent = t; b.id = "tab-" + t;
+  b.onclick = () => { tab = t; location.hash = t; render(); };
+  nav.appendChild(b);
+});
+function esc(t){
+  const d = document.createElement("div");
+  d.textContent = t;
+  return d.innerHTML;
+}
+function cell(v){
+  if (v === null || v === undefined) return "";
+  if (typeof v === "object") return esc(JSON.stringify(v));
+  return esc(String(v));
+}
+function table(rows){
+  if (!rows || !rows.length) return "<p>none</p>";
+  const cols = Object.keys(rows[0]);
+  let h = "<table><tr>" + cols.map(c=>`<th>${esc(c)}</th>`).join("")
+    + "</tr>";
+  for (const r of rows.slice(-200))
+    h += "<tr>" + cols.map(c=>`<td>${cell(r[c])}</td>`).join("") + "</tr>";
+  return h + "</table>";
+}
+async function render(){
+  TABS.forEach(t => document.getElementById("tab-"+t)
+    .classList.toggle("on", t === tab));
+  try {
+    const data = await (await fetch("/api/" + tab)).json();
+    document.getElementById("content").innerHTML =
+      tab === "summary" ? "<pre>" +
+        JSON.stringify(data, null, 2) + "</pre>" : table(data);
+    document.getElementById("refreshed").textContent =
+      "· " + new Date().toLocaleTimeString();
+  } catch (e) {
+    document.getElementById("content").textContent = "fetch failed: "+e;
+  }
+}
+render();
+setInterval(render, 3000);
+</script></body></html>"""
 
 
 class Dashboard:
@@ -71,22 +117,15 @@ class Dashboard:
                         if fn is None:
                             self.send_error(404, f"unknown api {kind!r}")
                             return
-                        self._send(json.dumps(fn()).encode(),
+                        rows = fn()
+                        # server-side cap: a long session's task list
+                        # would otherwise serialize MBs per 3s poll
+                        if isinstance(rows, list) and len(rows) > 500:
+                            rows = rows[-500:]
+                        self._send(json.dumps(rows).encode(),
                                    "application/json")
                     elif path in ("", "/"):
-                        body = []
-                        body.append("<h2>summary</h2><pre>%s</pre>"
-                                    % json.dumps(state.summary(),
-                                                 indent=2))
-                        body.append("<h2>nodes</h2>"
-                                    + _table(state.list_nodes()))
-                        body.append("<h2>actors</h2>"
-                                    + _table(state.list_actors()))
-                        tasks = state.list_tasks()
-                        body.append(f"<h2>tasks ({len(tasks)})</h2>"
-                                    + _table(tasks[-50:]))
-                        self._send((_PAGE % "".join(body)).encode(),
-                                   "text/html")
+                        self._send(_PAGE.encode(), "text/html")
                     else:
                         self.send_error(404)
                 except Exception as e:  # noqa: BLE001

@@ -1,0 +1,114 @@
+"""Autoscaler v2: instance lifecycle + cloud-provider reconciliation.
+
+Reference analog: ``python/ray/autoscaler/v2/tests`` [UNVERIFIED —
+mount empty, SURVEY.md §0].
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import NodeType
+from ray_tpu.autoscaler.v2 import (AutoscalerV2, FakeCloudProvider,
+                                   InstanceState)
+
+
+def _wait(pred, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = pred()
+        if result:
+            return result
+        time.sleep(0.05)
+    return pred()
+
+
+def test_v2_full_lifecycle_scales_up_and_runs(ray_start_cluster):
+    cluster = ray_start_cluster
+    provider = FakeCloudProvider(cluster, boot_delay_s=0.3)
+    scaler = AutoscalerV2(
+        provider,
+        [NodeType("gpuish", {"CPU": 2, "SPECIAL": 2}, max_workers=3)],
+        idle_timeout_s=60, period_s=0.1).start()
+    try:
+        @ray_tpu.remote(resources={"SPECIAL": 1})
+        def special():
+            return 42
+
+        ref = special.remote()     # infeasible until a node launches
+        assert ray_tpu.get(ref, timeout=60) == 42
+        inst = _wait(lambda: [i for i in scaler.instances.all()
+                              if i.state == InstanceState.RUNNING])
+        assert inst, scaler.instances.table()
+        # lifecycle history: QUEUED->REQUESTED->ALLOCATED->RUNNING
+        states = [t[2] for t in inst[0].transitions]
+        assert states == ["REQUESTED", "ALLOCATED", "RUNNING"], states
+        assert inst[0].node_id is not None
+    finally:
+        scaler.stop()
+
+
+def test_v2_allocation_failure_requeues(ray_start_cluster):
+    cluster = ray_start_cluster
+    provider = FakeCloudProvider(cluster, fail_first_n=2)
+    scaler = AutoscalerV2(
+        provider,
+        [NodeType("t", {"CPU": 1, "FLAKY": 1}, max_workers=2)],
+        idle_timeout_s=60, period_s=0.1,
+        max_launch_attempts=5).start()
+    try:
+        @ray_tpu.remote(resources={"FLAKY": 1})
+        def f():
+            return "ok"
+
+        assert ray_tpu.get(f.remote(), timeout=60) == "ok"
+        running = [i for i in scaler.instances.all()
+                   if i.state == InstanceState.RUNNING]
+        assert running and running[0].launch_attempts >= 3
+    finally:
+        scaler.stop()
+
+
+def test_v2_allocation_failure_budget_exhausts(ray_start_cluster):
+    cluster = ray_start_cluster
+    provider = FakeCloudProvider(cluster, fail_first_n=100)
+    scaler = AutoscalerV2(
+        provider,
+        [NodeType("t", {"CPU": 1, "NEVER": 1}, max_workers=1)],
+        idle_timeout_s=60, period_s=0.05, max_launch_attempts=2).start()
+    try:
+        @ray_tpu.remote(resources={"NEVER": 1})
+        def f():
+            return 1
+
+        f.remote()   # stays infeasible
+        failed = _wait(lambda: [
+            i for i in scaler.instances.all()
+            if i.state == InstanceState.ALLOCATION_FAILED])
+        assert failed and failed[0].launch_attempts == 2
+    finally:
+        scaler.stop()
+
+
+def test_v2_idle_termination(ray_start_cluster):
+    cluster = ray_start_cluster
+    provider = FakeCloudProvider(cluster)
+    scaler = AutoscalerV2(
+        provider, [NodeType("t", {"CPU": 1, "TMP": 1}, max_workers=1)],
+        idle_timeout_s=0.5, period_s=0.1).start()
+    try:
+        @ray_tpu.remote(resources={"TMP": 1})
+        def f():
+            return 1
+
+        assert ray_tpu.get(f.remote(), timeout=60) == 1
+        gone = _wait(lambda: [i for i in scaler.instances.all()
+                              if i.state == InstanceState.TERMINATED])
+        assert gone, scaler.instances.table()
+        # the node actually left the scheduler's view
+        w = cluster._worker
+        assert gone[0].node_id not in {
+            nid for nid, _ in w.node_group.cluster_resources.nodes()}
+    finally:
+        scaler.stop()
