@@ -10,9 +10,12 @@ gang (see ``ppo.py``).
 
 from ray_tpu.rl.env import CartPoleVec, VectorEnv, make_env, register_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
+from ray_tpu.rl.dqn import DQN, DQNConfig, ReplayBuffer, init_q_params
 from ray_tpu.rl.ppo import PPO, PPOConfig, init_policy_params
 
 __all__ = [
-    "PPO", "PPOConfig", "EnvRunner", "EnvRunnerGroup", "VectorEnv",
+    "PPO", "PPOConfig", "DQN", "DQNConfig", "ReplayBuffer",
+    "EnvRunner", "EnvRunnerGroup", "VectorEnv",
     "CartPoleVec", "make_env", "register_env", "init_policy_params",
+    "init_q_params",
 ]

@@ -1,0 +1,282 @@
+"""DQN: replay-buffer off-policy learning on the core API.
+
+Reference: ``rllib/algorithms/dqn/`` + ``rllib/utils/replay_buffers/``
+[UNVERIFIED — mount empty, SURVEY.md §0]. Same TPU-native shape as
+``ppo.py``: experience collection on cheap CPU actors (epsilon-greedy
+over the Q-network), the learner as ONE jitted program on the
+chip-owning driver. Double-DQN targets with a periodically-synced
+target network; the K gradient steps per iteration run inside a single
+``lax.scan`` so per-iteration device work is one launch.
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import ray_tpu
+from ray_tpu.rl.config import AlgorithmConfigBase
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.env_runner import EnvRunnerGroup
+
+
+def init_q_params(key, obs_dim: int, num_actions: int,
+                  hidden: int = 64) -> Dict[str, np.ndarray]:
+    k1, k2, k3 = jax.random.split(key, 3)
+
+    def dense(k, fan_in, shape):
+        return np.asarray(jax.random.normal(k, shape) / np.sqrt(fan_in),
+                          np.float32)
+
+    return {
+        "w1": dense(k1, obs_dim, (obs_dim, hidden)),
+        "b1": np.zeros(hidden, np.float32),
+        "w2": dense(k2, hidden, (hidden, hidden)),
+        "b2": np.zeros(hidden, np.float32),
+        # the runner's numpy mirror reads "wp"/"bp" as its action head
+        "wp": dense(k3, hidden, (hidden, num_actions)) * 0.01,
+        "bp": np.zeros(num_actions, np.float32),
+    }
+
+
+def _q_net(params, obs):
+    h = jnp.tanh(obs @ params["w1"] + params["b1"])
+    h = jnp.tanh(h @ params["w2"] + params["b2"])
+    return h @ params["wp"] + params["bp"]
+
+
+class ReplayBuffer:
+    """Uniform FIFO replay over transition arrays (the reference's
+    ReplayBuffer role, host-side numpy)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self._obs = np.empty((capacity, obs_dim), np.float32)
+        self._next_obs = np.empty((capacity, obs_dim), np.float32)
+        self._act = np.empty(capacity, np.int32)
+        self._rew = np.empty(capacity, np.float32)
+        self._done = np.empty(capacity, np.float32)
+        self._size = 0
+        self._pos = 0
+
+    def add_rollout(self, batch: Dict[str, np.ndarray]) -> None:
+        """Flatten a [T, B] runner rollout into transitions. The next
+        observation of step t is obs[t+1] (last step uses last_obs);
+        done cuts the bootstrap."""
+        obs, act = batch["obs"], batch["actions"]
+        rew, done = batch["rewards"], batch["dones"]
+        T, B = act.shape
+        next_obs = np.concatenate([obs[1:], batch["last_obs"][None]], 0)
+        flat = (obs.reshape(T * B, -1), next_obs.reshape(T * B, -1),
+                act.reshape(-1), rew.reshape(-1),
+                done.astype(np.float32).reshape(-1))
+        n = T * B
+        for i in range(0, n, self.capacity):
+            self._insert(*(a[i:i + self.capacity] for a in flat))
+
+    def _insert(self, obs, next_obs, act, rew, done) -> None:
+        n = len(act)
+        idx = (self._pos + np.arange(n)) % self.capacity
+        self._obs[idx] = obs
+        self._next_obs[idx] = next_obs
+        self._act[idx] = act
+        self._rew[idx] = rew
+        self._done[idx] = done
+        self._pos = int((self._pos + n) % self.capacity)
+        self._size = int(min(self._size + n, self.capacity))
+
+    def __len__(self) -> int:
+        return self._size
+
+    def sample(self, rng: np.random.RandomState, batch_size: int
+               ) -> Dict[str, np.ndarray]:
+        idx = rng.randint(0, self._size, batch_size)
+        return {"obs": self._obs[idx], "next_obs": self._next_obs[idx],
+                "actions": self._act[idx], "rewards": self._rew[idx],
+                "dones": self._done[idx]}
+
+    def sample_many(self, rng: np.random.RandomState, k: int,
+                    batch_size: int) -> Dict[str, np.ndarray]:
+        """[k, batch] of consistent transitions: ONE index matrix, one
+        gather per key (k separate sample() calls would do k*5 fancy
+        indexes + 5 stacks on the host hot path)."""
+        idx = rng.randint(0, self._size, (k, batch_size))
+        return {"obs": self._obs[idx], "next_obs": self._next_obs[idx],
+                "actions": self._act[idx], "rewards": self._rew[idx],
+                "dones": self._done[idx]}
+
+    def state_dict(self) -> dict:
+        n = self._size
+        return {"obs": self._obs[:n].copy(),
+                "next_obs": self._next_obs[:n].copy(),
+                "act": self._act[:n].copy(),
+                "rew": self._rew[:n].copy(),
+                "done": self._done[:n].copy(),
+                "pos": self._pos}
+
+    def load_state_dict(self, state: dict) -> None:
+        n = len(state["act"])
+        self._obs[:n] = state["obs"]
+        self._next_obs[:n] = state["next_obs"]
+        self._act[:n] = state["act"]
+        self._rew[:n] = state["rew"]
+        self._done[:n] = state["done"]
+        self._size = n
+        self._pos = int(state["pos"]) % self.capacity
+
+
+@dataclass
+class DQNConfig(AlgorithmConfigBase):
+    env: str = "CartPole"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 8
+    rollout_length: int = 64
+    lr: float = 1e-3
+    gamma: float = 0.99
+    buffer_capacity: int = 50_000
+    train_batch_size: int = 128
+    updates_per_iteration: int = 64
+    target_sync_every: int = 4      # iterations between target syncs
+    eps_start: float = 1.0
+    eps_end: float = 0.05
+    eps_decay_iters: int = 20
+    hidden: int = 64
+    seed: int = 0
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """Iterative trainer: ``train()`` = collect (epsilon-greedy) +
+    replay-sampled double-DQN updates. Tune-compatible (train() returns
+    metrics; save()/restore() round-trip state)."""
+
+    def __init__(self, cfg: DQNConfig):
+        self.cfg = cfg
+        probe = make_env(cfg.env, 1, cfg.seed)
+        self._obs_dim = probe.obs_dim
+        self._num_actions = probe.num_actions
+        self.params = init_q_params(jax.random.PRNGKey(cfg.seed),
+                                    self._obs_dim, self._num_actions,
+                                    cfg.hidden)
+        self.target_params = {k: v.copy() for k, v in self.params.items()}
+        self.buffer = ReplayBuffer(cfg.buffer_capacity, self._obs_dim)
+        self._tx = optax.adam(cfg.lr)
+        self.opt_state = self._tx.init(self.params)
+        self._rng = np.random.RandomState(cfg.seed)
+        self.iteration = 0
+        self.runners = EnvRunnerGroup(cfg.env, cfg.num_env_runners,
+                                      cfg.num_envs_per_runner, cfg.seed)
+        self._update = jax.jit(self._make_update())
+
+    def _make_update(self):
+        cfg = self.cfg
+
+        def td_loss(params, target_params, batch):
+            q = _q_net(params, batch["obs"])
+            q_a = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1)[:, 0]
+            # double DQN: online net argmaxes, target net evaluates
+            next_online = _q_net(params, batch["next_obs"])
+            next_act = jnp.argmax(next_online, axis=1)
+            next_target = _q_net(target_params, batch["next_obs"])
+            next_q = jnp.take_along_axis(
+                next_target, next_act[:, None], axis=1)[:, 0]
+            target = batch["rewards"] + cfg.gamma * (
+                1.0 - batch["dones"]) * jax.lax.stop_gradient(next_q)
+            return jnp.mean((q_a - target) ** 2)
+
+        def update(params, opt_state, target_params, batches):
+            def step(carry, batch):
+                p, o = carry
+                loss, grads = jax.value_and_grad(td_loss)(
+                    p, target_params, batch)
+                updates, o = self._tx.update(grads, o, p)
+                p = optax.apply_updates(p, updates)
+                return (p, o), loss
+            (params, opt_state), losses = jax.lax.scan(
+                step, (params, opt_state), batches)
+            return params, opt_state, losses
+
+        return update
+
+    def _epsilon(self) -> float:
+        cfg = self.cfg
+        frac = min(1.0, self.iteration / max(1, cfg.eps_decay_iters))
+        return cfg.eps_start + frac * (cfg.eps_end - cfg.eps_start)
+
+    def train(self) -> Dict[str, float]:
+        cfg = self.cfg
+        eps = self._epsilon()
+        rollouts = self.runners.collect(self.params, cfg.rollout_length,
+                                        explore_eps=eps)
+        returns: List[float] = []
+        for r in rollouts:
+            self.buffer.add_rollout(r)
+            returns.extend(r["episode_returns"].tolist())
+
+        losses = []
+        if len(self.buffer) >= cfg.train_batch_size:
+            K = cfg.updates_per_iteration
+            # one index matrix, one gather per key: [K, batch] of
+            # CONSISTENT transitions (per-key sampling would pair
+            # observations with unrelated actions/rewards)
+            batches = self.buffer.sample_many(
+                self._rng, K, cfg.train_batch_size)
+            new_params, self.opt_state, loss_arr = self._update(
+                self.params, self.opt_state, self.target_params,
+                batches)
+            self.params = {k: np.asarray(v)
+                           for k, v in new_params.items()}
+            losses = list(np.asarray(loss_arr))
+        self.iteration += 1
+        if self.iteration % cfg.target_sync_every == 0:
+            self.target_params = {k: v.copy()
+                                  for k, v in self.params.items()}
+        return {
+            "iteration": self.iteration,
+            "epsilon": round(eps, 4),
+            "episode_return_mean": (float(np.mean(returns))
+                                    if returns else float("nan")),
+            "num_episodes": len(returns),
+            "buffer_size": len(self.buffer),
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+        }
+
+    # -- checkpointing (Tune-compatible, PPO-matching path API) --------
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({
+                "params": self.params, "target": self.target_params,
+                "opt_state": jax.device_get(self.opt_state),
+                "iteration": self.iteration,
+                # off-policy state: without the buffer + rng a restore
+                # into a fresh process would resume with no replay data
+                # at end-schedule epsilon and stall
+                "buffer": self.buffer.state_dict(),
+                "rng": self._rng.get_state()}, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.params = state["params"]
+        self.target_params = state["target"]
+        self.opt_state = state.get("opt_state") or self._tx.init(
+            self.params)
+        self.iteration = state["iteration"]
+        if state.get("buffer") is not None:
+            self.buffer.load_state_dict(state["buffer"])
+        if state.get("rng") is not None:
+            self._rng.set_state(state["rng"])
+
+    def stop(self) -> None:
+        self.runners.shutdown()

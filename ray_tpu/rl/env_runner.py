@@ -39,8 +39,12 @@ class EnvRunner:
         self.rng = np.random.RandomState(seed + 10_000)
         self.obs = self.env.observe()
 
-    def collect(self, params: Dict[str, np.ndarray], rollout_len: int
+    def collect(self, params: Dict[str, np.ndarray], rollout_len: int,
+                explore_eps: Optional[float] = None
                 ) -> Dict[str, np.ndarray]:
+        """``explore_eps`` switches sampling to epsilon-greedy over the
+        action head (value-based algorithms); None keeps the
+        categorical policy sample (policy-gradient algorithms)."""
         T, B = rollout_len, self.env.num_envs
         obs_buf = np.empty((T, B, self.env.obs_dim), np.float32)
         act_buf = np.empty((T, B), np.int32)
@@ -50,16 +54,32 @@ class EnvRunner:
         for t in range(T):
             obs_buf[t] = self.obs
             logits = _policy_forward(params, self.obs)
-            # Gumbel-max categorical sample + log-prob
-            z = logits - logits.max(axis=1, keepdims=True)
-            probs = np.exp(z)
-            probs /= probs.sum(axis=1, keepdims=True)
-            gumbel = -np.log(-np.log(
-                self.rng.uniform(1e-9, 1.0, logits.shape)))
-            actions = np.argmax(logits + gumbel, axis=1).astype(np.int32)
+            if explore_eps is not None:
+                # epsilon-greedy over the action head; logp records the
+                # BEHAVIOR policy's probability (eps/n everywhere plus
+                # (1-eps) mass on the greedy action), not the softmax.
+                n_act = logits.shape[1]
+                greedy = np.argmax(logits, axis=1)
+                random_a = self.rng.randint(0, n_act, B)
+                explored = self.rng.uniform(size=B) < explore_eps
+                actions = np.where(explored, random_a,
+                                   greedy).astype(np.int32)
+                p_beh = np.full(B, explore_eps / n_act, np.float32)
+                p_beh[actions == greedy] += 1.0 - explore_eps
+                logp_buf[t] = np.log(p_beh + 1e-9)
+            else:
+                # Gumbel-max categorical sample + log-prob
+                z = logits - logits.max(axis=1, keepdims=True)
+                probs = np.exp(z)
+                probs /= probs.sum(axis=1, keepdims=True)
+                gumbel = -np.log(-np.log(
+                    self.rng.uniform(1e-9, 1.0, logits.shape)))
+                actions = np.argmax(logits + gumbel,
+                                    axis=1).astype(np.int32)
+                logp_buf[t] = np.log(
+                    probs[np.arange(B), actions] + 1e-9
+                ).astype(np.float32)
             act_buf[t] = actions
-            logp_buf[t] = np.log(
-                probs[np.arange(B), actions] + 1e-9).astype(np.float32)
             self.obs, rew_buf[t], done_buf[t] = self.env.step(actions)
         return {
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
@@ -96,9 +116,10 @@ class EnvRunnerGroup:
     def num_runners(self) -> int:
         return len(self._runners)
 
-    def collect(self, params: Dict[str, np.ndarray], rollout_len: int
+    def collect(self, params: Dict[str, np.ndarray], rollout_len: int,
+                explore_eps: Optional[float] = None
                 ) -> List[Dict[str, np.ndarray]]:
-        refs = [r.collect.remote(params, rollout_len)
+        refs = [r.collect.remote(params, rollout_len, explore_eps)
                 for r in self._runners]
         return ray_tpu.get(refs, timeout=300)
 

@@ -34,6 +34,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import ray_tpu
 from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+from ray_tpu.rl.config import AlgorithmConfigBase
 from ray_tpu.rl.env import make_env
 from ray_tpu.rl.env_runner import EnvRunnerGroup
 
@@ -84,7 +85,7 @@ def _net(params, obs):
 
 
 @dataclass
-class PPOConfig:
+class PPOConfig(AlgorithmConfigBase):
     env: str = "CartPole"
     num_env_runners: int = 2
     num_envs_per_runner: int = 16
@@ -101,28 +102,6 @@ class PPOConfig:
     learner_devices: Optional[int] = None   # None = all local devices
     use_placement_group: bool = True
     learner_resources: Dict[str, float] = field(default_factory=dict)
-
-    def environment(self, env: str) -> "PPOConfig":
-        self.env = env
-        return self
-
-    def env_runners(self, *, num_env_runners: Optional[int] = None,
-                    num_envs_per_runner: Optional[int] = None,
-                    rollout_length: Optional[int] = None) -> "PPOConfig":
-        if num_env_runners is not None:
-            self.num_env_runners = num_env_runners
-        if num_envs_per_runner is not None:
-            self.num_envs_per_runner = num_envs_per_runner
-        if rollout_length is not None:
-            self.rollout_length = rollout_length
-        return self
-
-    def training(self, **kw) -> "PPOConfig":
-        for k, v in kw.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown training option {k!r}")
-            setattr(self, k, v)
-        return self
 
     def resources(self, *, learner_devices: Optional[int] = None,
                   use_placement_group: Optional[bool] = None,
