@@ -933,14 +933,20 @@ class NodeManagerGroup:
             else:
                 plain.append(spec)
         batch = plain
-        requests = [
-            SchedulingRequest(
-                demand=spec.resources,
-                preferred_node=self.head_node_id,
-                strategy=spec.scheduling_strategy,
-            )
-            for spec in batch
-        ]
+        # Request objects are cached on the spec: a task retries on
+        # every capacity change until it fits, and rebuilding the
+        # request each tick was measurable at queue depth.
+        requests = []
+        for spec in batch:
+            req = getattr(spec, "_sched_request", None)
+            if req is None:
+                req = SchedulingRequest(
+                    demand=spec.resources,
+                    preferred_node=self.head_node_id,
+                    strategy=spec.scheduling_strategy,
+                )
+                spec._sched_request = req   # type: ignore[attr-defined]
+            requests.append(req)
         results = self._policy.schedule_batch(
             self.cluster_resources, requests) if requests else []
         for spec, res in zip(batch, results):

@@ -51,6 +51,11 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
         self._total: Optional[np.ndarray] = None
         self._alive: Optional[np.ndarray] = None
         self._avail: Optional[np.ndarray] = None
+        # demand-dict -> dense row (False = names an unknown resource);
+        # epoch-invalidated on _rebuild (resource columns changed)
+        self._row_cache: Dict = {}
+        self._row_epoch = 0
+        self._row_cache_epoch = -1
         # Single-task fast-path state: preallocated in/out buffers and
         # cached ctypes pointers (refreshed on _rebuild), so the p99 of
         # a light-load schedule() is the native scan itself, not Python
@@ -75,6 +80,7 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
         self._res_index = {name: j for j, name in enumerate(names)}
         self._node_order = list(snap.keys())
         self._node_index = {nid: i for i, nid in enumerate(self._node_order)}
+        self._row_epoch += 1          # resource columns may have moved
         n, r = len(self._node_order), max(len(names), 1)
         self._total = np.zeros((n, r), np.float32)
         self._alive = np.zeros(n, np.uint8)
@@ -172,24 +178,58 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
         # denying capacity to later requests in the same batch. Filter
         # them out and splice results back by position.
         res_index = self._res_index
+        # Demand rows cached by scheduling class: a pending queue is a
+        # handful of demand shapes repeated thousands of times, and the
+        # dict->row translation in Python dominated batch cost (the
+        # same task retries on every capacity change until it fits).
+        row_cache = self._row_cache
+        if self._row_cache_epoch != self._row_epoch:
+            # columns changed under us (an id()-based check would be
+            # unsound: CPython reuses freed dict addresses)
+            row_cache.clear()
+            self._row_cache_epoch = self._row_epoch
+        elif len(row_cache) > 4096:
+            # bound it: per-task memory/custom values make demand
+            # shapes arbitrarily high-cardinality in a long driver
+            row_cache.clear()
         unknown: Dict[int, bool] = {}
         kept: List[int] = []
+        rows: List[np.ndarray] = []
         for t, req in enumerate(requests):
-            for k in req.demand:
-                if k not in res_index:
+            # the key is cached ON the request: request objects are
+            # reused across retry ticks (node_manager caches them on
+            # the spec), so the sort runs once per task, not per tick
+            key = getattr(req, "_row_key", None)
+            if key is None:
+                key = tuple(sorted(req.demand.items()))
+                req._row_key = key     # type: ignore[attr-defined]
+            row = row_cache.get(key)
+            if row is None:
+                row = np.zeros(n_res, np.float32)
+                ok = True
+                for k, v in req.demand.items():
+                    j = res_index.get(k)
+                    if j is None:
+                        ok = False
+                        break
+                    row[j] = v
+                row_cache[key] = row if ok else False
+                if not ok:
                     unknown[t] = True
-                    break
-            if t not in unknown:
-                kept.append(t)
+                    continue
+            elif row is False:
+                unknown[t] = True
+                continue
+            kept.append(t)
+            rows.append(row)
         nreq = len(kept)
-        demands = np.zeros((max(nreq, 1), n_res), np.float32)
+        demands = (np.stack(rows) if rows
+                   else np.zeros((1, n_res), np.float32))
         preferred = np.full(max(nreq, 1), -1, np.int32)
-        for row, t in enumerate(kept):
+        for row_i, t in enumerate(kept):
             req = requests[t]
-            for k, v in req.demand.items():
-                demands[row, res_index[k]] = v
             if req.preferred_node is not None and not req.avoid_local:
-                preferred[row] = node_index.get(req.preferred_node, -1)
+                preferred[row_i] = node_index.get(req.preferred_node, -1)
         out_nodes = np.empty(max(nreq, 1), np.int32)
         out_inf = np.empty(max(nreq, 1), np.uint8)
         if nreq:
