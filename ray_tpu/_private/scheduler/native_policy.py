@@ -172,11 +172,11 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
         avail = self._matrices(cluster)
         n_nodes, n_res = avail.shape
         node_index = self._node_index
-        # Requests naming a resource no node has are infeasible outright.
-        # They must NOT reach the native loop: a partial demand row would
+        # Requests naming a resource no node has are infeasible outright
+        # and must NOT reach the native loop: a partial demand row would
         # be allocated from the shared batch-availability view, spuriously
-        # denying capacity to later requests in the same batch. Filter
-        # them out and splice results back by position.
+        # denying capacity to later requests in the same batch. They are
+        # simply skipped — results default to infeasible.
         res_index = self._res_index
         # Demand rows cached by scheduling class: a pending queue is a
         # handful of demand shapes repeated thousands of times, and the
@@ -192,7 +192,6 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
             # bound it: per-task memory/custom values make demand
             # shapes arbitrarily high-cardinality in a long driver
             row_cache.clear()
-        unknown: Dict[int, bool] = {}
         kept: List[int] = []
         rows: List[np.ndarray] = []
         for t, req in enumerate(requests):
@@ -215,10 +214,8 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
                     row[j] = v
                 row_cache[key] = row if ok else False
                 if not ok:
-                    unknown[t] = True
                     continue
             elif row is False:
-                unknown[t] = True
                 continue
             kept.append(t)
             rows.append(row)

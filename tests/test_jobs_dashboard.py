@@ -73,3 +73,59 @@ def test_dashboard_endpoints(ray_start_regular):
         assert "ray_tpu_tasks" in get("/metrics")
     finally:
         stop_dashboard()
+
+
+def test_dashboard_api_endpoints_full(ray_start_regular):
+    """Every JSON API endpoint serves well-formed rows; /metrics carries
+    runtime + per-node series; unknown endpoints 404; long task lists
+    are capped server-side."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def work(i):
+        return i
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    # >500 completed tasks so the server-side row cap is really hit
+    ray_tpu.get([work.remote(i) for i in range(520)]
+                + [a.ping.remote()])
+
+    host, port = start_dashboard()
+    base = f"http://{host}:{port}"
+
+    def get(path):
+        return urllib.request.urlopen(base + path, timeout=10).read()
+
+    try:
+        for kind, key in [("nodes", "node_id"), ("actors", "actor_id"),
+                          ("tasks", "task_id"), ("workers", "node_id"),
+                          ("objects", "object_id")]:
+            rows = _json.loads(get(f"/api/{kind}"))
+            assert isinstance(rows, list), kind
+            assert len(rows) <= 500
+            if kind == "tasks":
+                assert len(rows) == 500   # the cap actually engaged
+            if rows:
+                assert key in rows[0], (kind, rows[0])
+        metrics = get("/metrics").decode()
+        assert "ray_tpu_node_resource_available" in metrics
+        assert "# TYPE" in metrics
+        page = get("/").decode()
+        assert "ray_tpu" in page and "summary" in page
+        try:
+            get("/api/nonsense")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        stop_dashboard()
