@@ -38,8 +38,78 @@ from ray_tpu.serve._private.controller import (
 __all__ = [
     "deployment", "run", "delete", "get_deployment_handle", "start",
     "shutdown", "status", "http_address", "AutoscalingConfig",
-    "Deployment", "DeploymentHandle",
+    "Deployment", "DeploymentHandle", "multiplexed",
+    "get_multiplexed_model_id",
 ]
+
+# Per-request model id inside a replica (model multiplexing) — the
+# ContextVar lives with the replica so workers never import this
+# package's control-plane machinery.
+from ray_tpu.serve._private.replica import _multiplex_ctx
+
+
+def get_multiplexed_model_id() -> Optional[str]:
+    """The model id of the CURRENT request (set by
+    ``handle.options(multiplexed_model_id=...)``), or None."""
+    return _multiplex_ctx.get()
+
+
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorate a replica's model-loader method: results are cached
+    per model id in an LRU bounded by ``max_num_models_per_replica``
+    (reference: ``@serve.multiplexed``). Combined with the router's
+    sticky model→replica routing, each model's requests keep landing
+    where it is already loaded::
+
+        @serve.deployment(num_replicas=2)
+        class M:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            def get_model(self, model_id: str):
+                return load(model_id)
+
+            def __call__(self, x):
+                model = self.get_model(
+                    serve.get_multiplexed_model_id())
+                return model(x)
+    """
+    import functools
+    import threading as _threading
+    from collections import OrderedDict
+
+    def wrap(fn):
+        # cache + lock are PER decorated function (two multiplexed
+        # loaders on one class must not share entries or caps)
+        cache_attr = f"_rtpu_mux_cache_{fn.__name__}"
+        lock_attr = f"_rtpu_mux_lock_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def loader(self, model_id: str):
+            lock = getattr(self, lock_attr, None)
+            if lock is None:
+                lock = _threading.Lock()
+                setattr(self, lock_attr, lock)
+            # Serialize loads (threaded replicas would otherwise load
+            # the same model twice on a concurrent miss).
+            with lock:
+                cache = getattr(self, cache_attr, None)
+                if cache is None:
+                    cache = OrderedDict()
+                    setattr(self, cache_attr, cache)
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+                # Evict BEFORE loading: cap models resident at once
+                # (loading first would transiently hold cap+1 — an OOM
+                # on device-memory-sized models).
+                while len(cache) >= max_num_models_per_replica:
+                    cache.popitem(last=False)
+                model = fn(self, model_id)
+                cache[model_id] = model
+                return model
+
+        return loader
+
+    return wrap if _fn is None else wrap(_fn)
 
 _controller: Optional[ServeController] = None
 _proxy = None
@@ -62,20 +132,33 @@ def _get_controller(start_http: bool = False) -> ServeController:
 class DeploymentHandle:
     """Client handle: routes calls through the deployment's router."""
 
-    def __init__(self, name: str, replica_set):
+    def __init__(self, name: str, replica_set, _model_id=None):
         self.deployment_name = name
         self._replica_set = replica_set
+        self._model_id = _model_id
 
     def remote(self, *args, **kwargs):
-        return self._replica_set.assign("__call__", args, kwargs)
+        return self._replica_set.assign("__call__", args, kwargs,
+                                        model_id=self._model_id)
+
+    def options(self, *, multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        """Per-call options; ``multiplexed_model_id`` routes with model
+        affinity and exposes the id via get_multiplexed_model_id().
+        Returns a full handle (attribute-style methods and chained
+        options keep working)."""
+        return DeploymentHandle(self.deployment_name,
+                                self._replica_set,
+                                _model_id=multiplexed_model_id)
 
     def method(self, method_name: str):
         handle = self
 
         class _Method:
             def remote(self, *args, **kwargs):
-                return handle._replica_set.assign(method_name, args,
-                                                  kwargs)
+                return handle._replica_set.assign(
+                    method_name, args, kwargs,
+                    model_id=handle._model_id)
 
         return _Method()
 

@@ -10,6 +10,14 @@ serves the compiled program from then on.
 
 from __future__ import annotations
 
+import contextvars
+
+# Per-request model id (model multiplexing); re-exported by the public
+# package — defined HERE so replicas never import the full serve
+# package (controller/router machinery) just to reach one ContextVar.
+_multiplex_ctx: "contextvars.ContextVar" = contextvars.ContextVar(
+    "rtpu_serve_model_id", default=None)
+
 
 class ReplicaActor:
     """Wraps the user's deployment class/function."""
@@ -25,12 +33,19 @@ class ReplicaActor:
                 raise TypeError("function deployments take no init args")
             self._callable = target
 
-    def handle_request(self, method: str, args: tuple, kwargs: dict):
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       model_id=None):
         if method in ("__call__", ""):
             fn = self._callable
         else:
             fn = getattr(self._callable, method)
-        return fn(*args, **kwargs)
+        if model_id is None:
+            return fn(*args, **kwargs)
+        token = _multiplex_ctx.set(model_id)
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _multiplex_ctx.reset(token)
 
     def ping(self) -> str:
         return "pong"

@@ -44,6 +44,10 @@ class ReplicaSet:
         self._lock = threading.Lock()
         self._replicas: List = []          # ActorHandle list
         self._inflight: Dict[int, int] = {}  # id(handle) -> count
+        # model multiplexing: sticky model_id -> replica key, so a
+        # model's requests keep hitting the replica whose LRU already
+        # holds it (reference: model-aware replica scheduling)
+        self._model_routes: Dict[str, int] = {}
         self._rng = random.Random(0xF00D)
         self.total_assigned = 0
 
@@ -55,6 +59,12 @@ class ReplicaSet:
             self._replicas = list(replicas)
             self._inflight = {id(r): self._inflight.get(id(r), 0)
                               for r in replicas}
+            # Drop model pins to departed replicas NOW: a later handle
+            # object could reuse the freed id() and silently alias the
+            # stale route to an unrelated replica.
+            self._model_routes = {m: k
+                                  for m, k in self._model_routes.items()
+                                  if k in keep}
 
     def replicas(self) -> List:
         with self._lock:
@@ -70,13 +80,27 @@ class ReplicaSet:
 
     # -- assignment ----------------------------------------------------
 
-    def assign(self, method: str, args: tuple, kwargs: dict) -> ObjectRef:
+    def assign(self, method: str, args: tuple, kwargs: dict,
+               model_id: Optional[str] = None) -> ObjectRef:
         with self._lock:
             if not self._replicas:
                 raise RuntimeError(
                     f"deployment {self.deployment_name!r} has no live "
                     "replicas")
-            if len(self._replicas) == 1:
+            chosen = None
+            if model_id is not None:
+                key = self._model_routes.get(model_id)
+                if key is not None:
+                    chosen = next((r for r in self._replicas
+                                   if id(r) == key), None)
+                if chosen is None:
+                    # first sight of this model (or its replica died):
+                    # pin it to the least-loaded replica
+                    chosen = min(self._replicas,
+                                 key=lambda r: self._inflight.get(
+                                     id(r), 0))
+                    self._model_routes[model_id] = id(chosen)
+            elif len(self._replicas) == 1:
                 chosen = self._replicas[0]
             else:
                 # power of two choices on tracked queue length
@@ -86,7 +110,8 @@ class ReplicaSet:
             self._inflight[id(chosen)] = \
                 self._inflight.get(id(chosen), 0) + 1
             self.total_assigned += 1
-        ref = chosen.handle_request.remote(method, args, kwargs)
+        ref = chosen.handle_request.remote(method, args, kwargs,
+                                           model_id)
         self._watch(ref, id(chosen))
         return ref
 

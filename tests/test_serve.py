@@ -203,3 +203,79 @@ def test_jitted_model_replica(serve_instance):
     handle = serve.run(Model.bind())
     out = ray_tpu.get(handle.remote([1, 2, 3, 4]), timeout=120)
     assert len(out) == 4 and all(isinstance(v, float) for v in out)
+
+
+def test_model_multiplexing(ray_start_regular):
+    """Multiplexed deployments: per-replica LRU model cache + sticky
+    model->replica routing (a model's requests keep hitting the
+    replica that already loaded it); eviction beyond the cap."""
+    import os
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Multi:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "pid": os.getpid()}
+
+        def __call__(self, x):
+            model = self.get_model(serve.get_multiplexed_model_id())
+            return {"model": model["id"], "pid": model["pid"],
+                    "loads": list(self.loads), "x": x}
+
+    handle = serve.run(Multi.bind(), name="multi")
+    try:
+        h_a = handle.options(multiplexed_model_id="m-a")
+        h_b = handle.options(multiplexed_model_id="m-b")
+        outs_a = [ray_tpu.get(h_a.remote(i), timeout=60)
+                  for i in range(4)]
+        outs_b = [ray_tpu.get(h_b.remote(i), timeout=60)
+                  for i in range(4)]
+        # sticky: every m-a request hit ONE replica process; the model
+        # loaded once there despite 4 calls
+        assert len({o["pid"] for o in outs_a}) == 1
+        assert outs_a[-1]["loads"].count("m-a") == 1
+        assert len({o["pid"] for o in outs_b}) == 1
+        assert outs_b[-1]["loads"].count("m-b") == 1
+        # context: the id the replica saw matches the routed id
+        assert {o["model"] for o in outs_a} == {"m-a"}
+
+    finally:
+        serve.delete("multi")
+
+
+def test_model_multiplexing_lru_eviction(ray_start_regular):
+    """Deterministic eviction: ONE replica, cap 2, three models — the
+    least-recently-used model is evicted and reloads on return."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Multi:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return model_id
+
+        def __call__(self, _x):
+            mid = serve.get_multiplexed_model_id()
+            self.get_model(mid)
+            return list(self.loads)
+
+    handle = serve.run(Multi.bind(), name="mux-lru")
+    try:
+        for mid in ("a", "b", "a", "c", "b", "a"):
+            loads = ray_tpu.get(handle.options(
+                multiplexed_model_id=mid).remote(0), timeout=60)
+        # a, b load; 'a' hits; 'c' evicts LRU=b; 'b' reloads evicting
+        # LRU=a; 'a' reloads
+        assert loads == ["a", "b", "c", "b", "a"], loads
+    finally:
+        serve.delete("mux-lru")
