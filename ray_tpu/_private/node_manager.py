@@ -680,9 +680,32 @@ class NodeManagerGroup:
 
     def register_actor_worker(self, actor_id: ActorID, node_id: NodeID,
                               worker: BaseWorker, resources: dict,
-                              pg=None) -> None:
+                              pg=None, creation_spec=None) -> None:
         with self._lock:
             self._actor_workers[actor_id] = (node_id, worker, resources, pg)
+        if creation_spec is not None and isinstance(worker, ProcessWorker):
+            # Hot wire path: ship the constant half of every method-call
+            # payload once; per-call frames then carry only the varying
+            # fields ("atmpl" marker, see worker_process.merge_actor).
+            # Pipe FIFO ordering guarantees the template lands before
+            # any call that references it. Re-sent on restart (fresh
+            # worker). In-process workers skip this — their payloads
+            # are never pickled, so stripping saves nothing.
+            tmpl = {
+                "type": "exec_actor",
+                "actor_id": actor_id.binary(),
+                "function_id": creation_spec.function.function_id,
+                "owner_addr": self.object_server_addr,
+                "kwargs_keys": [],
+                "num_returns": 1,
+                "runtime_env": None,
+                "cls": creation_spec.name or "Actor",
+            }
+            try:
+                worker.send(("actor_tmpl", actor_id.binary(), tmpl))
+                worker.actor_tmpl = actor_id.binary()
+            except Exception:
+                pass
 
     def set_actor_death_callback(self, cb: Callable) -> None:
         self._actor_death_cb = cb
@@ -729,42 +752,108 @@ class NodeManagerGroup:
 
     def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec,
                           payload: dict) -> bool:
+        return self.submit_actor_task_batch(actor_id,
+                                            [(spec, payload)]) == 1
+
+    def submit_actor_task_batch(self, actor_id: ActorID,
+                                items: List[Tuple[TaskSpec, dict]]) -> int:
+        """Submit N ORDERED actor calls in one wire frame (the batched
+        half of the actor hot path). Returns the number submitted from
+        the front of ``items`` — 0 when the worker is dead/missing,
+        partial when an argument rewrite fails mid-batch; the caller
+        requeues the remainder IN ORDER."""
+        from ray_tpu._private import events
         with self._lock:
             entry = self._actor_workers.get(actor_id)
             if entry is None or not entry[1].alive:
-                return False
-            _, worker, _, _ = entry
-            self._running[spec.task_id] = RunningTask(
-                spec, entry[0], worker, {})
+                return 0
+            node_id, worker, _res, _pg = entry
         if isinstance(worker, RemoteActorWorker):
-            if not self._rewrite_actor_args_for_remote(worker.handle,
-                                                       payload):
-                with self._lock:
-                    self._running.pop(spec.task_id, None)
-                return False
-            payload = dict(payload, resources={},
-                           function_id=payload["function_id"])
+            handle = worker.handle
+            sendable = []
+            for spec, payload in items:
+                if not self._rewrite_actor_args_for_remote(handle,
+                                                           payload):
+                    break
+                sendable.append((spec, dict(payload, resources={})))
+            if not sendable:
+                return 0
+            with self._lock:
+                for spec, _p in sendable:
+                    self._running[spec.task_id] = RunningTask(
+                        spec, node_id, worker, {})
             try:
-                worker.handle.client.call(
-                    "submit", payload,
+                handle.client.call(
+                    "submit_batch", [p for _s, p in sendable],
                     timeout=get_config().worker_lease_timeout_ms / 1000.0)
             except Exception:
                 with self._lock:
-                    self._running.pop(spec.task_id, None)
-                return False
-            from ray_tpu._private import events
-            events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
-                          worker=f"node:{worker.handle.node_id.hex()[:8]}")
-            return True
-        if not self._rewrite_actor_args_for_local(payload):
+                    for spec, _p in sendable:
+                        self._running.pop(spec.task_id, None)
+                return 0
+            wname = f"node:{handle.node_id.hex()[:8]}"
+            for spec, _p in sendable:
+                events.record(spec.task_id.hex(), spec.repr_name(),
+                              "RUNNING", worker=wname)
+            return len(sendable)
+        sendable = []
+        for spec, payload in items:
+            if not self._rewrite_actor_args_for_local(payload):
+                break
+            sendable.append((spec, payload))
+        if not sendable:
+            return 0
+        tmpl_aid = getattr(worker, "actor_tmpl", None)
+        if tmpl_aid is not None:
+            # compiled-DAG stage payloads carry their own template
+            # (stage_key) and a different shape — never strip those
+            wire = [p if "stage_key" in p
+                    else self._strip_actor_payload(p, tmpl_aid)
+                    for _s, p in sendable]
+        else:
+            wire = [p for _s, p in sendable]
+        with self._lock:
+            for spec, _p in sendable:
+                self._running[spec.task_id] = RunningTask(
+                    spec, node_id, worker, {})
+        try:
+            worker.send(("exec_actor_batch", wire))
+        except Exception:
             with self._lock:
-                self._running.pop(spec.task_id, None)
-            return False
-        worker.send(("exec_actor", payload))
-        from ray_tpu._private import events
-        events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
-                      worker=worker.worker_id.hex()[:8])
-        return True
+                for spec, _p in sendable:
+                    self._running.pop(spec.task_id, None)
+            return 0
+        wname = worker.worker_id.hex()[:8]
+        for spec, _p in sendable:
+            events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
+                          worker=wname)
+        return len(sendable)
+
+    @staticmethod
+    def _strip_actor_payload(payload: dict, tmpl_aid: bytes) -> dict:
+        """Drop the template-covered constants from a method-call
+        payload before pickling it onto the pipe (the worker merges
+        them back from its registered template)."""
+        out = {
+            "atmpl": tmpl_aid,
+            "task_id": payload["task_id"],
+            "method": payload["method"],
+            "args": payload["args"],
+            "return_ids": payload["return_ids"],
+        }
+        if payload.get("kwargs_keys"):
+            out["kwargs_keys"] = payload["kwargs_keys"]
+        if payload.get("num_returns", 1) != 1:
+            out["num_returns"] = payload["num_returns"]
+        if payload.get("streaming"):
+            out["streaming"] = True
+            if payload.get("stream_skip"):
+                out["stream_skip"] = payload["stream_skip"]
+        if payload.get("publish"):
+            out["publish"] = payload["publish"]
+        if payload.get("runtime_env"):
+            out["runtime_env"] = payload["runtime_env"]
+        return out
 
     def _rewrite_actor_args_for_local(self, payload: dict) -> bool:
         """Localize remote-located args for an actor on a driver-process
@@ -815,6 +904,17 @@ class NodeManagerGroup:
             return
         node_id, worker, resources, pg = entry
         if kill_worker:
+            # Calls already in flight on the worker die with the actor:
+            # fail them with the actor-death error (not a generic
+            # worker-crash) so callers see the kill for what it was.
+            from ray_tpu.exceptions import ActorDiedError
+            with self._lock:
+                dead = [tid for tid, rt in self._running.items()
+                        if rt.worker is worker
+                        and rt.spec.task_type == TaskType.ACTOR_TASK]
+            for tid in dead:
+                self._fail_running(tid, ActorDiedError(
+                    "actor was killed while this call was in flight"))
             worker.send(("shutdown",))
             worker.kill()
             with self._lock:
@@ -1194,6 +1294,11 @@ class NodeManagerGroup:
 
     def _handle_reply(self, worker: BaseWorker, reply: tuple) -> None:
         op = reply[0]
+        if op == "batch":
+            # coalesced completions (one frame, N replies)
+            for r in reply[1]:
+                self._handle_reply(worker, r)
+            return
         if op == "stream":
             # streaming generator item; the task keeps running
             _, task_id_b, results = reply
@@ -1249,7 +1354,7 @@ class NodeManagerGroup:
             else:
                 self.register_actor_worker(
                     ActorID(actor_id_b), rt.node_id, worker, rt.resources,
-                    pg=rt.pg)
+                    pg=rt.pg, creation_spec=rt.spec)
                 self._complete_task(task_id, [], None, None)
 
     def _io_loop(self) -> None:

@@ -105,6 +105,7 @@ class RayletServer:
         self.server.register("stats", lambda ctx: self.stats())
         self.server.register("read_logs", self._handle_read_logs)
         self.server.register("submit", self._handle_submit)
+        self.server.register("submit_batch", self._handle_submit_batch)
         self.server.register("kill_actor", self._handle_kill_actor)
         self.server.register("adjust_pool", self._handle_adjust_pool)
         self.server.register("shutdown", lambda ctx: self._request_shutdown())
@@ -163,6 +164,23 @@ class RayletServer:
             self._functions[payload["function_id"]] = blob
         with self._lock:
             self._dispatch_queue.append(payload)
+        self._wake.set()
+        return "ok"
+
+    def _handle_submit_batch(self, ctx: ConnectionContext,
+                             payloads: list) -> str:
+        """Admit N ordered actor-call payloads in one RPC round trip
+        (the remote-actor leg of the batched wire path). Actor calls
+        ride the actor's standing allocation, so no admission check."""
+        blob_updates = {}
+        for payload in payloads:
+            blob = payload.pop("function_blob", None)
+            if blob is not None:
+                blob_updates[payload["function_id"]] = blob
+        if blob_updates:
+            self._functions.update(blob_updates)
+        with self._lock:
+            self._dispatch_queue.extend(payloads)
         self._wake.set()
         return "ok"
 
@@ -370,6 +388,11 @@ class RayletServer:
 
     def _handle_worker_reply(self, worker: BaseWorker, reply: tuple) -> None:
         op = reply[0]
+        if op == "batch":
+            # coalesced completions from a batched/async actor worker
+            for r in reply[1]:
+                self._handle_worker_reply(worker, r)
+            return
         if op == "stream":
             # streaming generator item: seal big items locally, relay
             # the (location) descriptors to the owner

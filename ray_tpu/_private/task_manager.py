@@ -78,6 +78,10 @@ class TaskManager:
         self._lock = threading.RLock()
         self._tasks: Dict[TaskID, TaskRecord] = {}
         self._lineage: Dict[ObjectID, TaskID] = {}
+        # live lineage entries per task — release_lineage must be O(1),
+        # not a scan over every retained object (ref churn after a
+        # large wave would otherwise go quadratic)
+        self._lineage_count: Dict[TaskID, int] = {}
         self._store_result = store_result
         self._resubmit = resubmit
         self._release_arg = on_task_arg_release
@@ -94,8 +98,11 @@ class TaskManager:
             self._tasks[spec.task_id] = TaskRecord(
                 spec=spec, retries_left=spec.max_retries,
                 reconstructions_left=spec.max_retries)
+            # an oid embeds its producing task id, so re-adding the same
+            # spec (actor restart) simply restores its full entry set
             for oid in spec.return_ids:
                 self._lineage[oid] = spec.task_id
+            self._lineage_count[spec.task_id] = len(spec.return_ids)
 
     def mark_running(self, task_id: TaskID) -> None:
         with self._lock:
@@ -225,10 +232,14 @@ class TaskManager:
             tid = self._lineage.pop(object_id, None)
             if tid is None:
                 return
-            if not any(t == tid for t in self._lineage.values()):
-                rec = self._tasks.get(tid)
-                if rec and rec.status in ("finished", "failed"):
-                    self._tasks.pop(tid, None)
+            left = self._lineage_count.get(tid, 1) - 1
+            if left > 0:
+                self._lineage_count[tid] = left
+                return
+            self._lineage_count.pop(tid, None)
+            rec = self._tasks.get(tid)
+            if rec and rec.status in ("finished", "failed"):
+                self._tasks.pop(tid, None)
 
     def list_records(self) -> List[TaskRecord]:
         with self._lock:

@@ -220,17 +220,30 @@ class Worker:
         self.node_stats: Dict[NodeID, Tuple[float, dict]] = {}
         # streaming tasks: highest item index delivered (retry resume)
         self._stream_progress: Dict[TaskID, int] = {}
+        # object-ready callbacks (serve router in-flight accounting and
+        # any other completion hook) — fired inline on the completion
+        # path, so no per-ref waiter threads
+        self._ready_cb_lock = threading.Lock()
+        self._ready_callbacks: Dict[ObjectID, List] = {}
         self.gcs.publisher.subscribe("RESOURCES", self._on_resource_report)
 
         # per-actor ordered submission queues; _actor_flush_locks
-        # serialize pop+send per actor so concurrent flushers (driver
-        # thread + IO thread) can't reorder a queue's head.
+        # serialize pop+send per actor so concurrent flushers can't
+        # reorder a queue's head. Flushing itself runs on a dedicated
+        # flusher thread: submitters only append + signal, so a tight
+        # .remote() loop runs ahead of the wire and calls accumulate
+        # into real batches (one frame per flush, not per call).
         self._actor_lock = threading.RLock()
         self._actor_queues: Dict[ActorID, deque] = {}
         self._actor_seq: Dict[ActorID, int] = {}
         self._actor_specs: Dict[ActorID, TaskSpec] = {}   # creation specs
         self._actor_restarts: Dict[ActorID, int] = {}
         self._actor_flush_locks: Dict[ActorID, threading.RLock] = {}
+        self._actor_flush_wake = threading.Event()
+        self._actor_flusher = threading.Thread(
+            target=self._actor_flush_loop, daemon=True,
+            name="rtpu-actor-flush")
+        self._actor_flusher.start()
 
         from ray_tpu._private.stats import install_runtime_metrics
         install_runtime_metrics()
@@ -375,6 +388,17 @@ class Worker:
             if driver_children:
                 self.reference_counter.add_contained(oid, driver_children)
         self.memory_store.put(oid, entry)
+        # Always under the lock (no unlocked emptiness fast-path): a
+        # concurrent on_object_ready() registration that saw the store
+        # pre-put must not slip past this pop, or its callback would
+        # never fire.
+        with self._ready_cb_lock:
+            cbs = self._ready_callbacks.pop(oid, None)
+        for cb in cbs or ():
+            try:
+                cb(oid)
+            except Exception:
+                logger.exception("object-ready callback failed")
         self.node_group.on_object_available(oid)
         self._flush_actor_queues()
 
@@ -459,6 +483,16 @@ class Worker:
                     node_id, available)
         except Exception:
             logger.exception("resource report handling failed")
+
+    def on_object_ready(self, oid: ObjectID, callback) -> None:
+        """Invoke ``callback(oid)`` once the object is in the owner's
+        directory (immediately if already there). Callbacks run inline
+        on the completion path — keep them cheap and non-blocking."""
+        with self._ready_cb_lock:
+            if not self.memory_store.contains(oid):
+                self._ready_callbacks.setdefault(oid, []).append(callback)
+                return
+        callback(oid)
 
     def _on_ref_zero(self, oid: ObjectID) -> None:
         self.memory_store.free(oid)
@@ -1245,7 +1279,8 @@ class Worker:
         task_id = TaskID.of(actor_id)
         spec_args: List[TaskArg] = []
         kwargs_keys = self.build_args(args, kwargs, spec_args)
-        num_returns = options.num_returns
+        streaming = options.num_returns == "streaming"
+        num_returns = 1 if streaming else options.num_returns
         return_ids = [ObjectID.from_index(task_id, i + 1)
                       for i in range(num_returns)]
         with self._actor_lock:
@@ -1266,6 +1301,7 @@ class Worker:
             actor_id=actor_id,
             sequence_number=seq,
             name=f"{info.class_name}.{method_name}",
+            streaming=streaming,
             return_ids=return_ids,
         )
         spec.method_name = method_name  # type: ignore[attr-defined]
@@ -1282,10 +1318,28 @@ class Worker:
         return [ObjectRef(oid) for oid in return_ids]
 
     def _flush_actor_queues(self) -> None:
-        with self._actor_lock:
-            actor_ids = [aid for aid, q in self._actor_queues.items() if q]
-        for actor_id in actor_ids:
-            self._flush_one_actor(actor_id)
+        # Signal the flusher thread instead of flushing inline: the
+        # submitting thread keeps producing while the flusher drains
+        # whatever accumulated (adaptive batching).
+        self._actor_flush_wake.set()
+
+    def _actor_flush_loop(self) -> None:
+        wake = self._actor_flush_wake
+        while not getattr(self, "_shutdown", False):
+            wake.wait(timeout=0.2)
+            if getattr(self, "_shutdown", False):
+                return
+            wake.clear()
+            try:
+                with self._actor_lock:
+                    actor_ids = [aid for aid, q in
+                                 self._actor_queues.items() if q]
+                for actor_id in actor_ids:
+                    self._flush_one_actor(actor_id)
+            except Exception:
+                logger.exception("actor flush loop error")
+
+    _ACTOR_FLUSH_BATCH = 256   # max calls per wire frame
 
     def _flush_one_actor(self, actor_id: ActorID) -> None:
         info = self.gcs.get_actor_info(actor_id)
@@ -1296,27 +1350,43 @@ class Worker:
                 actor_id, threading.RLock())
         # Serialize the whole pop+send per actor: without this, two
         # flushers could pop seq N and N+1 and send them out of order.
+        # (All flushing runs on the flusher thread; anything appended
+        # after this drain re-sets the wake event, so one pass is
+        # enough — no retry loop.)
         with flush_lock:
-            while True:
-                with self._actor_lock:
-                    queue = self._actor_queues.get(actor_id)
-                    if not queue:
-                        return
+            self._drain_actor_queue(actor_id)
+
+    def _drain_actor_queue(self, actor_id: ActorID) -> None:
+        """Pop every dep-ready call (in order) and ship them in ONE
+        batched frame per round — the submit half of the batched actor
+        wire path. Flush-lock held by the caller."""
+        while True:
+            batch: List[TaskSpec] = []
+            with self._actor_lock:
+                queue = self._actor_queues.get(actor_id)
+                while queue and len(batch) < self._ACTOR_FLUSH_BATCH:
                     spec = queue[0]
                     deps = spec.dependencies()
-                    if not all(self.memory_store.contains(d) for d in deps):
-                        return
+                    if deps and not all(self.memory_store.contains(d)
+                                        for d in deps):
+                        break
                     queue.popleft()
+                    batch.append(spec)
+            if not batch:
+                return
+            items: List[Tuple[TaskSpec, dict]] = []
+            requeue_from = None
+            for i, spec in enumerate(batch):
                 try:
                     payload, dep_err = self._build_actor_payload(spec)
                 except _LostObjectSignal as sig:
                     lost_oid = sig.args[0]
                     if self._recover_object(lost_oid):
-                        # requeue behind the reconstruction; the purged
+                        # requeue this call AND everything behind it (in
+                        # order) behind the reconstruction; the purged
                         # entry keeps the dependency check unsatisfied
-                        with self._actor_lock:
-                            self._actor_queues[actor_id].appendleft(spec)
-                        return
+                        requeue_from = i
+                        break
                     self._fail_task(spec, ObjectLostError(
                         f"argument {lost_oid} of {spec.repr_name()} was "
                         "lost and cannot be reconstructed"))
@@ -1325,13 +1395,25 @@ class Worker:
                     self.task_manager.complete_task(spec.task_id, [],
                                                     dep_err, None)
                     continue
-                self.task_manager.mark_running(spec.task_id)
-                ok = self.node_group.submit_actor_task(actor_id, spec,
-                                                       payload)
-                if not ok:
-                    with self._actor_lock:
-                        self._actor_queues[actor_id].appendleft(spec)
-                    return
+                items.append((spec, payload))
+            leftovers: List[TaskSpec] = []
+            if items:
+                for spec, _p in items:
+                    self.task_manager.mark_running(spec.task_id)
+                n = self.node_group.submit_actor_task_batch(actor_id,
+                                                            items)
+                if n < len(items):
+                    leftovers.extend(s for s, _p in items[n:])
+            if requeue_from is not None:
+                leftovers.extend(batch[requeue_from:])
+            if leftovers:
+                # put back at the FRONT in submission order; a later
+                # flush (worker ready / object reconstructed) retries
+                with self._actor_lock:
+                    q = self._actor_queues.get(actor_id)
+                    if q is not None:
+                        q.extendleft(reversed(leftovers))
+                return
 
     def _build_actor_payload(self, spec: TaskSpec):
         arg_descs = []
@@ -1386,6 +1468,9 @@ class Worker:
             "runtime_env": spec.runtime_env,
             "owner_addr": self.node_group.object_server_addr,
         }
+        if spec.streaming:
+            payload["streaming"] = True
+            payload["stream_skip"] = spec.stream_skip
         return payload, None
 
     def _on_actor_death(self, actor_id: ActorID) -> None:
@@ -1444,6 +1529,7 @@ class Worker:
         if self._shutdown:
             return
         self._shutdown = True
+        self._actor_flush_wake.set()
         if getattr(self, "_log_monitor", None) is not None:
             self._log_monitor.stop()
         from ray_tpu.util import metrics as _metrics

@@ -134,7 +134,6 @@ class InProcessWorker(BaseWorker):
         self.env = ExecutionEnv(session, max_inline_bytes)
         self._queue: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._reply = reply_handler
-        self._pools: dict = {}      # actor_id -> capped pool
         self.ready = True
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
@@ -142,38 +141,29 @@ class InProcessWorker(BaseWorker):
         self._thread.start()
 
     def _loop(self):
+        # Execution routing (thread pools for max_concurrency>1 sync
+        # actors — jax dispatch releases the GIL while the device
+        # computes, so threads overlap device work — and per-actor
+        # event loops for async actors) lives in ExecutionEnv.dispatch,
+        # shared with process workers.
+        def send(reply):
+            self._reply(self, reply)
+
         while True:
             msg = self._queue.get()
             if msg is None:
-                for pool in self._pools.values():
-                    pool.shutdown(wait=False)
+                self.env.shutdown_exec()
                 return
             op = msg[0]
             if op == "func":
                 self.env.cache_function(msg[1], msg[2])
             elif op == "dag_stage":
                 self.env.dag_stages[msg[1]] = msg[2]
-            elif op in ("exec", "create_actor", "exec_actor"):
-                payload = self.env.merge_stage(msg[1])
-                emit = lambda r: self._reply(self, r)  # noqa: E731
-                conc = (self.env._actor_conc.get(
-                    payload.get("actor_id"), 1)
-                    if op == "exec_actor" else 1)
-                if conc > 1:
-                    # TPU actors honor max_concurrency too: jax
-                    # dispatch releases the GIL while the device
-                    # computes, so threads overlap device work.
-                    aid = payload["actor_id"]
-                    pool = self._pools.get(aid)
-                    if pool is None:
-                        from concurrent.futures import ThreadPoolExecutor
-                        pool = ThreadPoolExecutor(max_workers=conc)
-                        self._pools[aid] = pool
-                    pool.submit(lambda p=payload: self._reply(
-                        self, self.env.execute(p, emit=emit)))
-                else:
-                    self._reply(self, self.env.execute(payload,
-                                                       emit=emit))
+            elif op == "actor_tmpl":
+                self.env.actor_templates[msg[1]] = msg[2]
+            elif op in ("exec", "create_actor", "exec_actor",
+                        "exec_actor_batch"):
+                self.env.dispatch(op, msg[1], send)
 
     def send(self, msg: tuple) -> None:
         if msg[0] == "shutdown":

@@ -22,17 +22,31 @@ Wire protocol (pickled tuples over a multiprocessing Pipe):
     ("exec", payload)                           run a normal task
     ("create_actor", payload)                   instantiate actor
     ("exec_actor", payload)                     run actor method (ordered)
+    ("exec_actor_batch", [payload, ...])        N ordered actor calls,
+                                                ONE frame (hot path)
+    ("actor_tmpl", actor_id, template)          constant half of this
+                                                actor's call payloads
     ("shutdown",)
   worker -> driver:
     ("ready", pid)
     ("done", task_id, [(oid, kind, data, contained_refs)], err)
         kind: "inline" -> data = serialized blob
               "shm"    -> data = (segment_name, size)
+    ("batch", [reply, ...])                     coalesced completions
     ("actor_ready", actor_id, err)
+
+Async actors: an actor class with any ``async def`` method executes ALL
+its calls on a dedicated per-actor asyncio event loop thread, with
+``max_concurrency`` bounding in-flight coroutines (reference semantics:
+``python/ray/actor.py`` async execution — calls START in submission
+order and may interleave at awaits). Completions landing in the same
+loop iteration coalesce into one ("batch", ...) frame.
 """
 
 from __future__ import annotations
 
+import contextvars
+import inspect
 import os
 import threading
 import traceback
@@ -53,18 +67,30 @@ from ray_tpu.exceptions import TaskError
 # blocked-parent resource release under max_concurrency>1).
 _TASK_FALLBACK: Dict[str, Any] = {"owner_addr": None, "task_id": b""}
 
+# Async-actor coroutines interleave on ONE loop thread, so their task
+# identity rides a contextvar (copied per asyncio task) instead of the
+# thread-local.
+_CTX_TASK: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "rtpu_ctx_task", default=None)
+
 
 class _TaskLocal(threading.local):
     """Per-THREAD pointer at the currently-executing task's owner
     channel — thread-local because max_concurrency>1 actors execute
     calls on a pool, and nested API calls must bind to their own
     task's identity; threads the executor never tagged fall back to
-    the process-level value."""
+    the process-level value. Asyncio-actor calls override via the
+    per-asyncio-task contextvar."""
 
     owner_addr = None
     task_id = b""
 
     def get(self, key, default=None):
+        ctx = _CTX_TASK.get()
+        if ctx is not None:
+            value = ctx.get(key)
+            if value:
+                return value
         value = getattr(self, key, None)
         if value is None or value == b"":
             value = _TASK_FALLBACK.get(key)
@@ -88,6 +114,15 @@ class ExecutionEnv:
         # payload, registered once at compile time so per-execute
         # messages ship only {task_id, args, return_ids, publish}.
         self.dag_stages: Dict[bytes, dict] = {}
+        # Actor-call templates: the constant half of every method-call
+        # payload for one actor (function_id, owner_addr, ...),
+        # registered when the actor worker is leased so the per-call
+        # frame ships only the varying fields ("atmpl" key).
+        self.actor_templates: Dict[bytes, dict] = {}
+        # actor_id -> its thread pool (max_concurrency>1 sync actors)
+        self._pools: Dict[bytes, Any] = {}
+        # actor_id -> _AsyncActorLoop (actors with async def methods)
+        self._aloops: Dict[bytes, "_AsyncActorLoop"] = {}
         self.shm_client = ShmClient(session)
         self.serde = serialization.get_context()
         self.current_task_name = ""
@@ -106,6 +141,86 @@ class ExecutionEnv:
                     "kwargs_keys": [], "name": "compiled-dag-stage",
                     "_missing_stage": True}
         return {**template, **payload}
+
+    def merge_actor(self, payload: dict) -> dict:
+        key = payload.get("atmpl")
+        if key is None:
+            return payload
+        template = self.actor_templates.get(key)
+        if template is None:
+            return {**payload, "type": "exec_actor",
+                    "actor_id": key,
+                    "num_returns": len(payload.get("return_ids", ())),
+                    "kwargs_keys": [], "name": "actor-call",
+                    "_missing_stage": True}
+        merged = {**template, **payload}
+        if "name" not in payload:
+            merged["name"] = (f"{template.get('cls', 'Actor')}"
+                              f".{payload.get('method', '?')}")
+        return merged
+
+    # -- dispatch ----------------------------------------------------------
+
+    def dispatch(self, op: str, body, send: Callable[[tuple], None]) -> None:
+        """Route one inbound exec-family message. ``send`` must be
+        thread-safe (replies may come from pool threads or an actor's
+        asyncio loop). Shared by process workers (worker_main) and
+        in-process workers (worker_pool.InProcessWorker)."""
+        if op == "exec_actor_batch":
+            payloads = [self.merge_stage(self.merge_actor(p)) for p in body]
+            if not payloads:
+                return
+            aid = payloads[0].get("actor_id")
+            aloop = self._aloops.get(aid)
+            if aloop is not None:
+                aloop.submit_batch(payloads, send)
+                return
+            conc = self._actor_conc.get(aid, 1)
+            if conc > 1:
+                pool = self._pool_for(aid, conc)
+                for p in payloads:
+                    pool.submit(
+                        lambda p=p: send(self.execute(p, emit=send)))
+                return
+            if len(payloads) == 1:
+                send(self.execute(payloads[0], emit=send))
+                return
+            replies = [self.execute(p, emit=send) for p in payloads]
+            send(("batch", replies))
+            return
+        payload = self.merge_stage(self.merge_actor(body))
+        if op == "exec_actor":
+            aid = payload.get("actor_id")
+            aloop = self._aloops.get(aid)
+            if aloop is not None:
+                aloop.submit(payload, send)
+                return
+            conc = self._actor_conc.get(aid, 1)
+            if conc > 1:
+                pool = self._pool_for(aid, conc)
+                pool.submit(lambda p=payload: send(self.execute(p,
+                                                                emit=send)))
+                return
+        send(self.execute(payload, emit=send))
+
+    def _pool_for(self, actor_id: bytes, conc: int):
+        # one pool PER actor sized to its declared cap — max_concurrency
+        # bounds in-flight calls, it is not a boolean
+        pool = self._pools.get(actor_id)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            pool = ThreadPoolExecutor(max_workers=conc)
+            self._pools[actor_id] = pool
+        return pool
+
+    def shutdown_exec(self) -> None:
+        """Stop per-actor execution machinery (pools + async loops)."""
+        for pool in self._pools.values():
+            pool.shutdown(wait=False)
+        self._pools.clear()
+        for aloop in self._aloops.values():
+            aloop.shutdown()
+        self._aloops.clear()
 
     @staticmethod
     def _apply_runtime_env(runtime_env: Optional[dict]) -> Callable[[], None]:
@@ -254,13 +369,19 @@ class ExecutionEnv:
             try:
                 if payload["type"] == "create_actor":
                     instance = fn(*args, **kwargs)
-                    self.actors[payload["actor_id"]] = instance
+                    aid = payload["actor_id"]
+                    self.actors[aid] = instance
                     # actors keep their runtime_env for their lifetime
-                    self._actor_envs[payload["actor_id"]] = \
-                        payload.get("runtime_env")
-                    self._actor_conc[payload["actor_id"]] = \
-                        payload.get("max_concurrency", 1)
-                    return ("actor_ready", payload["actor_id"], None)
+                    self._actor_envs[aid] = payload.get("runtime_env")
+                    conc = payload.get("max_concurrency", 1)
+                    self._actor_conc[aid] = conc
+                    if _has_async_methods(instance):
+                        # async actor: a dedicated event loop executes
+                        # every call; max_concurrency caps in-flight
+                        # coroutines (reference async-actor semantics).
+                        self._aloops[aid] = _AsyncActorLoop(
+                            self, aid, max(1, conc))
+                    return ("actor_ready", aid, None)
                 if payload["type"] == "exec_actor":
                     instance = self.actors[payload["actor_id"]]
                     method = getattr(instance, payload["method"])
@@ -326,6 +447,103 @@ class ExecutionEnv:
                 return ("actor_ready", payload["actor_id"], blob)
             return ("done", task_id, [], blob,
                     {"exec_ms": 1e3 * (_time.perf_counter() - t_start)})
+
+    async def execute_async(self, payload: dict, emit=None) -> tuple:
+        """Async-actor variant of ``execute``: runs ON the actor's event
+        loop thread; awaits coroutine results and drains async
+        generators for streaming calls. Sync methods of an async actor
+        also run here (they hold the loop while executing — reference
+        async-actor semantics). Returns the ("done", ...) reply."""
+        import asyncio
+        import time as _time
+        task_id = payload["task_id"]
+        t_start = _time.perf_counter()
+        # Task identity rides the per-asyncio-task context: coroutines
+        # interleave on one thread, so a thread-local would leak one
+        # call's identity into another across awaits.
+        _CTX_TASK.set({"owner_addr": payload.get("owner_addr"),
+                       "task_id": task_id})
+        try:
+            if payload.get("_missing_stage"):
+                raise RuntimeError(
+                    "actor-call template missing (the actor's worker "
+                    "restarted mid-stream); retry the call")
+            instance = self.actors[payload["actor_id"]]
+            method = getattr(instance, payload["method"])
+            args, kwargs = self.resolve_args(payload["args"],
+                                             payload["kwargs_keys"])
+            self.current_task_name = payload.get("name", "")
+            result = method(*args, **kwargs)
+            if payload.get("streaming"):
+                return await self._drain_async_generator(payload, result,
+                                                         emit)
+            if inspect.isawaitable(result):
+                result = await result
+            pre_ser = None
+            if payload.get("publish"):
+                pre_ser = self.serde.serialize(result)
+                self._publish_channels(payload["publish"],
+                                       pre_ser.to_bytes())
+            n = payload["num_returns"]
+            values = (result,) if n == 1 else tuple(result) if n > 0 else ()
+            if n > 1 and len(values) != n:
+                raise ValueError(
+                    f"task declared num_returns={n} but returned "
+                    f"{len(values)} values")
+            results = self.store_results(payload["return_ids"], values,
+                                         pre_ser=pre_ser if n == 1 else
+                                         None)
+            return ("done", task_id, results, None,
+                    {"exec_ms": 1e3 * (_time.perf_counter() - t_start)})
+        except asyncio.CancelledError:
+            # actor shutting down mid-call: no reply — the owner fails
+            # the task through worker-death handling
+            raise
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, task_repr=payload.get("name", "?"),
+                            traceback_str=traceback.format_exc())
+            try:
+                blob = self.serde.serialize(err).to_bytes()
+            except Exception:
+                blob = self.serde.serialize(
+                    TaskError(None, payload.get("name", "?"),
+                              traceback.format_exc())).to_bytes()
+            if payload.get("publish"):
+                try:
+                    self._publish_channels(payload["publish"], blob,
+                                           kind="err")
+                except Exception:
+                    pass
+            return ("done", task_id, [], blob,
+                    {"exec_ms": 1e3 * (_time.perf_counter() - t_start)})
+
+    async def _drain_async_generator(self, payload: dict, result, emit
+                                     ) -> tuple:
+        """Streaming drain for async actors: accepts an async generator,
+        a plain generator, or an awaitable resolving to either."""
+        if inspect.isawaitable(result):
+            result = await result
+        if inspect.isgenerator(result):
+            return self._drain_generator(payload, result, emit)
+        if not inspect.isasyncgen(result):
+            raise TypeError(
+                "num_returns='streaming' requires the method to return "
+                f"a generator or async generator, got "
+                f"{type(result).__name__}")
+        task_id = payload["task_id"]
+        tid = TaskID(task_id)
+        count = 0
+        skip = payload.get("stream_skip", 0)
+        async for item in result:
+            count += 1
+            if count <= skip:
+                continue
+            oid_b = ObjectID.from_index(tid, count + 1).binary()
+            stored = self.store_results([oid_b], (item,))
+            if emit is not None:
+                emit(("stream", task_id, stored))
+        done = self.store_results([payload["return_ids"][0]], (count,))
+        return ("done", task_id, done, None)
 
     @staticmethod
     def _with_trace_annotation(name: str, call):
@@ -394,15 +612,135 @@ class ExecutionEnv:
         self.functions[function_id] = cloudpickle.loads(blob)
 
 
+def _has_async_methods(instance) -> bool:
+    """True if any public method of the actor is ``async def`` (plain
+    coroutine or async generator) — the trigger for the async-actor
+    runtime. Inspects the CLASS, never the instance: instance getattr
+    would execute property/descriptor getters during create_actor."""
+    cls = type(instance)
+    for name in dir(cls):
+        if name.startswith("_"):
+            continue
+        m = inspect.getattr_static(cls, name, None)
+        if isinstance(m, (staticmethod, classmethod)):
+            m = m.__func__
+        if m is not None and (inspect.iscoroutinefunction(m)
+                              or inspect.isasyncgenfunction(m)):
+            return True
+    return False
+
+
+class _AsyncActorLoop:
+    """Per-actor asyncio event-loop thread: the async-actor runtime.
+
+    Calls START in submission order (call_soon_threadsafe preserves the
+    dispatch thread's order; so does create_task) and up to
+    ``concurrency`` coroutines run interleaved; the rest queue on a
+    FIFO semaphore. Completed-call replies landing in the same loop
+    iteration coalesce into one ("batch", ...) frame back to the owner
+    (the batched completion half of the hot wire path).
+    """
+
+    def __init__(self, env: ExecutionEnv, actor_id: bytes,
+                 concurrency: int):
+        import asyncio
+        self._env = env
+        self._actor_id = actor_id
+        self._concurrency = concurrency
+        self.loop = asyncio.new_event_loop()
+        self._sem: Optional["asyncio.Semaphore"] = None
+        self._buf: list = []
+        self._flush_scheduled = False
+        self._send: Optional[Callable[[tuple], None]] = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"rtpu-async-actor-{actor_id[:4].hex()}")
+        self._thread.start()
+        self._started.wait(5)
+
+    def _run(self) -> None:
+        import asyncio
+        asyncio.set_event_loop(self.loop)
+        self._sem = asyncio.Semaphore(self._concurrency)
+        self.loop.call_soon(self._started.set)
+        try:
+            self.loop.run_forever()
+        finally:
+            # Cancellation-on-kill: anything still in flight is
+            # cancelled so the process/thread can exit; the owner fails
+            # those tasks through actor-death handling.
+            try:
+                tasks = asyncio.all_tasks(self.loop)
+                for t in tasks:
+                    t.cancel()
+                if tasks:
+                    self.loop.run_until_complete(
+                        asyncio.gather(*tasks, return_exceptions=True))
+            except Exception:
+                pass
+            self.loop.close()
+
+    def submit(self, payload: dict, send: Callable[[tuple], None]) -> None:
+        self.submit_batch([payload], send)
+
+    def submit_batch(self, payloads: List[dict],
+                     send: Callable[[tuple], None]) -> None:
+        """One loop wakeup per inbound frame, however many calls it
+        carries."""
+        self._send = send
+        try:
+            self.loop.call_soon_threadsafe(self._start_batch, payloads)
+        except RuntimeError:
+            # loop already closed (actor shutting down): the owner
+            # fails these tasks via worker/actor-death handling
+            pass
+
+    def _start_batch(self, payloads: List[dict]) -> None:
+        for p in payloads:
+            self.loop.create_task(self._call(p))
+
+    async def _call(self, payload: dict) -> None:
+        async with self._sem:
+            reply = await self._env.execute_async(payload,
+                                                  emit=self._emit)
+        self._buf.append(reply)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self.loop.call_soon(self._flush)
+
+    def _emit(self, msg: tuple) -> None:
+        # stream items ship immediately (latency over batching); reply
+        # ordering vs the final done is preserved by the shared send
+        send = self._send
+        if send is not None:
+            send(msg)
+
+    def _flush(self) -> None:
+        self._flush_scheduled = False
+        buf, self._buf = self._buf, []
+        send = self._send
+        if not buf or send is None:
+            return
+        send(buf[0] if len(buf) == 1 else ("batch", buf))
+
+    def shutdown(self) -> None:
+        try:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+        except RuntimeError:
+            pass
+
+
 def worker_main(conn, session: str, max_inline_bytes: int,
                 env_vars: Optional[dict] = None) -> None:
     """Message loop of a process worker (conn already registered).
 
-    Actors created with ``max_concurrency > 1`` execute their calls on
-    a thread pool (ordering across in-flight calls is not guaranteed,
-    the reference's threaded-actor semantics); everything else runs on
-    the loop thread. All sends share one lock — Connection.send is not
-    thread-safe.
+    Execution routing lives in ``ExecutionEnv.dispatch``: sync actors
+    with ``max_concurrency > 1`` run on a per-actor thread pool
+    (ordering across in-flight calls not guaranteed — threaded-actor
+    semantics), async actors on a per-actor event loop, everything else
+    on this loop thread. All sends share one lock — Connection.send is
+    not thread-safe.
     """
     if env_vars:
         os.environ.update(env_vars)
@@ -416,7 +754,6 @@ def worker_main(conn, session: str, max_inline_bytes: int,
         with send_lock:
             conn.send(reply)
 
-    pools: Dict[bytes, Any] = {}   # actor_id -> its capped pool
     try:
         while True:
             try:
@@ -430,24 +767,11 @@ def worker_main(conn, session: str, max_inline_bytes: int,
                 env.cache_function(msg[1], msg[2])
             elif op == "dag_stage":
                 env.dag_stages[msg[1]] = msg[2]
-            elif op in ("exec", "create_actor", "exec_actor"):
-                payload = env.merge_stage(msg[1])
-                conc = (env._actor_conc.get(payload.get("actor_id"), 1)
-                        if op == "exec_actor" else 1)
-                if conc > 1:
-                    # one pool PER actor sized to its declared cap —
-                    # max_concurrency bounds in-flight calls, it is not
-                    # a boolean
-                    aid = payload["actor_id"]
-                    pool = pools.get(aid)
-                    if pool is None:
-                        from concurrent.futures import ThreadPoolExecutor
-                        pool = ThreadPoolExecutor(max_workers=conc)
-                        pools[aid] = pool
-                    pool.submit(
-                        lambda p=payload: send(env.execute(p, emit=send)))
-                else:
-                    send(env.execute(payload, emit=send))
+            elif op == "actor_tmpl":
+                env.actor_templates[msg[1]] = msg[2]
+            elif op in ("exec", "create_actor", "exec_actor",
+                        "exec_actor_batch"):
+                env.dispatch(op, msg[1], send)
             elif op == "core_addr":
                 # Compiled-DAG channel binding: report this process's
                 # owner-core address (creates the core on first ask).
@@ -456,8 +780,7 @@ def worker_main(conn, session: str, max_inline_bytes: int,
             elif op == "ping":
                 send(("pong",))
     finally:
-        for pool in pools.values():
-            pool.shutdown(wait=False)
+        env.shutdown_exec()
         env.shm_client.close()
         core = worker_core.try_worker_core()
         if core is not None:

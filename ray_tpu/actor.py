@@ -52,11 +52,17 @@ class ActorMethod:
         return _Bound()
 
     def _remote(self, args, kwargs, overrides):
-        opts = TaskOptions(
-            num_returns=overrides.get("num_returns", self._num_returns))
+        num_returns = overrides.get("num_returns", self._num_returns)
+        opts = TaskOptions(num_returns=num_returns)
         refs = global_worker().submit_actor_task(
             self._handle._actor_id, self._method_name, args, kwargs, opts)
-        if opts.num_returns == 1:
+        if num_returns == "streaming":
+            # Generator (or async-generator) method: items stream to
+            # the owner as they are yielded (reference: streaming
+            # generator actor tasks).
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+            return ObjectRefGenerator(refs[0].id().task_id(), refs[0])
+        if num_returns == 1:
             return refs[0]
         return refs
 
@@ -119,9 +125,23 @@ class ActorClass:
 
         return _Bound()
 
+    def _has_async_methods(self) -> bool:
+        import inspect
+        return any(
+            inspect.iscoroutinefunction(m) or inspect.isasyncgenfunction(m)
+            for m in (getattr(self._cls, n, None)
+                      for n in dir(self._cls) if not n.startswith("_"))
+            if m is not None)
+
     def _remote(self, args, kwargs, options_dict) -> ActorHandle:
         opts = TaskOptions(**{k: v for k, v in options_dict.items()
                               if k in TaskOptions.__dataclass_fields__})
+        if "max_concurrency" not in options_dict \
+                and self._has_async_methods():
+            # Async actors default to a high in-flight cap (reference:
+            # async actors default max_concurrency=1000) — the event
+            # loop, not a thread pool, is the concurrency substrate.
+            opts.max_concurrency = 1000
         from ray_tpu.util.scheduling_strategies import (
             apply_placement_group_option)
         apply_placement_group_option(opts)
