@@ -113,6 +113,7 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
 
 _controller: Optional[ServeController] = None
 _proxy = None
+_worker_proxy = None     # ActorHandle of the worker-hosted ProxyActor
 _lock = threading.Lock()
 
 
@@ -132,24 +133,33 @@ def _get_controller(start_http: bool = False) -> ServeController:
 class DeploymentHandle:
     """Client handle: routes calls through the deployment's router."""
 
-    def __init__(self, name: str, replica_set, _model_id=None):
+    def __init__(self, name: str, replica_set, _model_id=None,
+                 _stream=False):
         self.deployment_name = name
         self._replica_set = replica_set
         self._model_id = _model_id
+        self._stream = _stream
 
     def remote(self, *args, **kwargs):
         return self._replica_set.assign("__call__", args, kwargs,
-                                        model_id=self._model_id)
+                                        model_id=self._model_id,
+                                        stream=self._stream)
 
-    def options(self, *, multiplexed_model_id: Optional[str] = None
-                ) -> "DeploymentHandle":
+    def options(self, *, multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         """Per-call options; ``multiplexed_model_id`` routes with model
-        affinity and exposes the id via get_multiplexed_model_id().
-        Returns a full handle (attribute-style methods and chained
-        options keep working)."""
-        return DeploymentHandle(self.deployment_name,
-                                self._replica_set,
-                                _model_id=multiplexed_model_id)
+        affinity and exposes the id via get_multiplexed_model_id();
+        ``stream=True`` makes ``remote`` return an ObjectRefGenerator
+        over the deployment's (possibly async) generator response
+        (reference: handle.options(stream=True)). Returns a full
+        handle (attribute-style methods and chained options keep
+        working)."""
+        return DeploymentHandle(
+            self.deployment_name, self._replica_set,
+            _model_id=(multiplexed_model_id
+                       if multiplexed_model_id is not None
+                       else self._model_id),
+            _stream=self._stream if stream is None else bool(stream))
 
     def method(self, method_name: str):
         handle = self
@@ -158,7 +168,8 @@ class DeploymentHandle:
             def remote(self, *args, **kwargs):
                 return handle._replica_set.assign(
                     method_name, args, kwargs,
-                    model_id=handle._model_id)
+                    model_id=handle._model_id,
+                    stream=handle._stream)
 
         return _Method()
 
@@ -259,22 +270,67 @@ def status() -> dict:
     return _get_controller().status()
 
 
-def start(http: bool = True):
-    """Start serve (optionally with the HTTP ingress)."""
-    return _get_controller(start_http=http)
+def start(http: bool = True, proxy_location: str = "driver"):
+    """Start serve, optionally with the HTTP ingress.
+
+    ``proxy_location``:
+    - "driver": threaded server in the driver process (tests).
+    - "worker": the ingress runs in a WORKER process (the reference's
+      proxy-actor topology) — HTTP parsing and response serialization
+      stay off the driver's scheduling threads; the controller pushes
+      route-table updates to it.
+    """
+    global _worker_proxy
+    if proxy_location not in ("driver", "worker"):
+        raise ValueError(f"unknown proxy_location {proxy_location!r}")
+    controller = _get_controller(
+        start_http=http and proxy_location == "driver")
+    if http and proxy_location == "worker":
+        with _lock:
+            if _worker_proxy is None:
+                import ray_tpu
+                from ray_tpu._private.worker import global_worker
+                from ray_tpu.serve._private.http_proxy import ProxyActor
+                from ray_tpu.util.scheduling_strategies import (
+                    NodeAffinitySchedulingStrategy)
+                # Pin to the head node: the proxy binds loopback and
+                # advertises its address to local clients — landing it
+                # on a remote raylet would hand out an unreachable
+                # 127.0.0.1 of another machine.
+                head = global_worker().node_group.head_node_id.hex()
+                actor = ray_tpu.remote(ProxyActor).options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=head)).remote()
+                ray_tpu.get(actor.ping.remote(), timeout=60)
+                _worker_proxy = actor
+                controller.register_proxy(actor)
+    return controller
 
 
 def http_address():
+    """(host, port) of the ingress — the worker-hosted proxy when one
+    is up, else the in-driver server (started on demand)."""
+    if _worker_proxy is not None:
+        import ray_tpu
+        return tuple(ray_tpu.get(_worker_proxy.address.remote(),
+                                 timeout=30))
     _get_controller(start_http=True)
     return _proxy.address
 
 
 def shutdown() -> None:
-    global _controller, _proxy
+    global _controller, _proxy, _worker_proxy
     with _lock:
         if _proxy is not None:
             _proxy.shutdown()
             _proxy = None
+        if _worker_proxy is not None:
+            try:
+                import ray_tpu
+                ray_tpu.kill(_worker_proxy)
+            except Exception:
+                pass
+            _worker_proxy = None
         if _controller is not None:
             _controller.shutdown()
             _controller = None

@@ -62,10 +62,45 @@ class ServeController:
     def __init__(self):
         self._lock = threading.RLock()
         self._deployments: Dict[str, DeploymentInfo] = {}
+        # worker-hosted ingress proxies fed by route-table pushes
+        self._proxies: List = []
+        self._pushed_routes: Dict[str, tuple] = {}
         self._shutdown = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="rtpu-serve-controller")
         self._thread.start()
+
+    # -- worker-hosted ingress -----------------------------------------
+
+    def register_proxy(self, proxy_handle) -> None:
+        """Attach a ProxyActor: it receives the current route table now
+        and every membership change from here on."""
+        with self._lock:
+            self._proxies.append(proxy_handle)
+            infos = list(self._deployments.values())
+        for info in infos:
+            try:
+                proxy_handle.update_routes.remote(info.name,
+                                                  info.replica_set)
+            except Exception:
+                logger.exception("proxy route push failed")
+
+    def _push_routes(self, info: DeploymentInfo) -> None:
+        """Push this deployment's replica snapshot to every proxy when
+        membership changed since the last push. Keyed on stable actor
+        ids — id() reuse after a replica swap would alias a changed
+        membership to the cached key."""
+        key = tuple(r._actor_id.hex() for r in info.replicas)
+        with self._lock:
+            if self._pushed_routes.get(info.name) == key:
+                return
+            self._pushed_routes[info.name] = key
+            proxies = list(self._proxies)
+        for proxy in proxies:
+            try:
+                proxy.update_routes.remote(info.name, info.replica_set)
+            except Exception:
+                logger.exception("proxy route push failed")
 
     # -- API -----------------------------------------------------------
 
@@ -98,9 +133,16 @@ class ServeController:
     def delete(self, name: str) -> None:
         with self._lock:
             info = self._deployments.pop(name, None)
+            self._pushed_routes.pop(name, None)
+            proxies = list(self._proxies)
         if info is not None:
             self._kill_replicas(info.replicas)
             info.replica_set.set_replicas([])
+            for proxy in proxies:
+                try:
+                    proxy.update_routes.remote(name, None)
+                except Exception:
+                    pass
 
     def get_replica_set(self, name: str) -> Optional[ReplicaSet]:
         with self._lock:
@@ -152,6 +194,8 @@ class ServeController:
             self._reconcile_deployment(info)
 
     def _reconcile_deployment(self, info: DeploymentInfo) -> None:
+        if self._shutdown.is_set():
+            return
         # 1. drop dead replicas (replica-death recovery)
         live = []
         for handle in info.replicas:
@@ -180,10 +224,29 @@ class ServeController:
         info.state = ("HEALTHY"
                       if len(info.replicas) >= max(1, info.num_replicas)
                       else "DEPLOYING")
+        self._push_routes(info)
+
+    def _proxy_ongoing(self, name: str) -> int:
+        """Aggregate in-flight counts from worker-hosted proxies: their
+        pickled ReplicaSet snapshots charge requests locally, invisible
+        to the driver-side set — without this, proxy traffic would
+        never scale a deployment up."""
+        with self._lock:
+            proxies = list(self._proxies)
+        total = 0
+        for proxy in proxies:
+            try:
+                total += int(ray_tpu.get(proxy.ongoing.remote(name),
+                                         timeout=2))
+            except Exception:
+                pass        # dead/slow proxy: count what we can see
+        return total
 
     def _autoscale(self, info: DeploymentInfo) -> None:
         cfg = info.autoscaling
         ongoing = info.replica_set.total_inflight()
+        if self._proxies:
+            ongoing += self._proxy_ongoing(info.name)
         current = max(len(info.replicas), 1)
         per_replica = ongoing / current
         now = time.monotonic()
@@ -219,8 +282,11 @@ class ServeController:
             ray_tpu.get(handle.ping.remote(), timeout=120)
             return handle
         except Exception:
-            logger.exception("serve %s: replica creation failed",
-                             info.name)
+            # A reconcile tick racing runtime teardown is not an error
+            # worth a traceback in CI logs (round-3 weak #8c).
+            if not self._shutdown.is_set():
+                logger.exception("serve %s: replica creation failed",
+                                 info.name)
             return None
 
     @staticmethod

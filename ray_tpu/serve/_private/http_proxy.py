@@ -1,11 +1,22 @@
-"""Minimal HTTP ingress.
+"""HTTP ingress: in-driver (test) and worker-hosted (deployable).
 
 Reference: ``python/ray/serve/_private/proxy.py`` (uvicorn/starlette
-proxy actors) [UNVERIFIED — mount empty, SURVEY.md §0]. A threaded
-stdlib HTTP server in the driver process: ``POST /<deployment>`` with a
-JSON (or raw bytes) body routes through the deployment's pow-2 router
-and returns the result. Enough ingress to exercise real HTTP routing
-in tests without external deps.
+proxy actors, streaming responses over chunked transfer) [UNVERIFIED —
+mount empty, SURVEY.md §0].
+
+Two placements share one handler:
+
+- ``HttpProxy``: a threaded stdlib server in the driver process —
+  zero-setup ingress for tests and notebooks.
+- ``ProxyActor``: the same server hosted in a WORKER process (the
+  reference's proxy-actor topology): HTTP parsing/serialization runs
+  off the driver's threads, and the controller pushes route-table
+  updates to it as replica membership changes.
+
+Streaming: ``POST /<deployment>?stream=1`` (or the
+``X-RTPU-Stream: 1`` header / ``Accept: text/event-stream``) responds
+with chunked transfer encoding — one JSON line per yielded item,
+written as the replica produces them.
 """
 
 from __future__ import annotations
@@ -14,63 +25,109 @@ import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
-
-import ray_tpu
+from typing import Callable, Optional
 
 logger = logging.getLogger(__name__)
 
 
-class HttpProxy:
-    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0):
-        self._controller = controller
-        proxy = self
+def _make_handler(get_replica_set: Callable[[str], Optional[object]],
+                  status_fn: Callable[[], dict]):
+    """One handler class over any route-table source (controller in the
+    driver, pushed table in a proxy worker)."""
+    import ray_tpu
 
-        class _Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # noqa: ANN002 - silence stdlib
-                pass
+    class _Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
 
-            def do_POST(self):  # noqa: N802 - stdlib naming
-                name = self.path.strip("/").split("/")[0]
-                replica_set = proxy._controller.get_replica_set(name)
-                if replica_set is None:
-                    self.send_error(404, f"no deployment {name!r}")
+        def log_message(self, *a):  # noqa: ANN002 - silence stdlib
+            pass
+
+        def _wants_stream(self) -> bool:
+            if "stream=1" in (self.path.partition("?")[2] or ""):
+                return True
+            if self.headers.get("X-RTPU-Stream") == "1":
+                return True
+            return "text/event-stream" in self.headers.get("Accept", "")
+
+        def do_POST(self):  # noqa: N802 - stdlib naming
+            path = self.path.partition("?")[0]
+            name = path.strip("/").split("/")[0]
+            replica_set = get_replica_set(name)
+            if replica_set is None:
+                self.send_error(404, f"no deployment {name!r}")
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b""
+            ctype = self.headers.get("Content-Type", "")
+            try:
+                if "json" in ctype and body:
+                    args = (json.loads(body),)
+                elif body:
+                    args = (body,)
+                else:
+                    args = ()
+                if self._wants_stream():
+                    self._stream_response(replica_set, args)
                     return
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length) if length else b""
-                ctype = self.headers.get("Content-Type", "")
-                try:
-                    if "json" in ctype and body:
-                        payload = json.loads(body)
-                        args = (payload,)
-                    elif body:
-                        args = (body,)
-                    else:
-                        args = ()
-                    ref = replica_set.assign("__call__", args, {})
-                    result = ray_tpu.get(ref, timeout=120)
-                except Exception as e:  # noqa: BLE001 - surfaces as 500
-                    self.send_error(500, str(e)[:500])
-                    return
-                blob = json.dumps(result, default=str).encode()
+                ref = replica_set.assign("__call__", args, {})
+                result = ray_tpu.get(ref, timeout=120)
+            except Exception as e:  # noqa: BLE001 - surfaces as 500
+                self.send_error(500, str(e)[:500])
+                return
+            blob = json.dumps(result, default=str).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def _stream_response(self, replica_set, args) -> None:
+            """Chunked transfer: one JSON line per streamed item,
+            flushed as the replica yields it — the client reads items
+            before the producer finishes."""
+            gen = replica_set.assign("__call__", args, {}, stream=True)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(blob: bytes) -> None:
+                self.wfile.write(f"{len(blob):x}\r\n".encode()
+                                 + blob + b"\r\n")
+                self.wfile.flush()
+
+            try:
+                for ref in gen:
+                    item = ray_tpu.get(ref, timeout=120)
+                    chunk(json.dumps(item, default=str).encode() + b"\n")
+            except Exception as e:  # noqa: BLE001 - mid-stream failure
+                chunk(json.dumps({"error": str(e)[:500]}).encode()
+                      + b"\n")
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+
+        def do_GET(self):  # noqa: N802
+            if self.path.rstrip("/") in ("", "/-", "/-/routes"):
+                blob = json.dumps(status_fn()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(blob)))
                 self.end_headers()
                 self.wfile.write(blob)
+            else:
+                self.do_POST()
 
-            def do_GET(self):  # noqa: N802
-                if self.path.rstrip("/") in ("", "/-", "/-/routes"):
-                    blob = json.dumps(proxy._controller.status()).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(blob)))
-                    self.end_headers()
-                    self.wfile.write(blob)
-                else:
-                    self.do_POST()
+    return _Handler
 
-        self._server = ThreadingHTTPServer((host, port), _Handler)
+
+class HttpProxy:
+    """In-driver ingress (tests/notebooks)."""
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0):
+        self._controller = controller
+        handler = _make_handler(controller.get_replica_set,
+                                controller.status)
+        self._server = ThreadingHTTPServer((host, port), handler)
         self.address = self._server.server_address
         self._thread = threading.Thread(
             target=self._server.serve_forever, kwargs={"poll_interval": 0.1},
@@ -83,3 +140,59 @@ class HttpProxy:
             self._server.server_close()
         except Exception:
             pass
+
+
+class ProxyActor:
+    """Worker-hosted ingress: the HTTP server lives in this actor's
+    worker process, so request parsing/serialization never contends
+    with the driver's scheduling threads. The controller pushes
+    ``update_routes`` whenever a deployment's replica membership
+    changes (the pushed ReplicaSet pickles as a snapshot with fresh
+    local in-flight counts)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._routes = {}            # name -> ReplicaSet snapshot
+        self._lock = threading.Lock()
+        handler = _make_handler(self._get_replica_set, self._status)
+        self._server = ThreadingHTTPServer((host, port), handler)
+        self._addr = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            daemon=True, name="rtpu-serve-http-worker")
+        self._thread.start()
+
+    def _get_replica_set(self, name: str):
+        with self._lock:
+            return self._routes.get(name)
+
+    def _status(self) -> dict:
+        with self._lock:
+            return {name: {"live_replicas": rs.num_replicas(),
+                           "ongoing_requests": rs.total_inflight()}
+                    for name, rs in self._routes.items()}
+
+    def ongoing(self, name: str) -> int:
+        """In-flight requests this proxy currently has against one
+        deployment (the controller aggregates these into its
+        autoscaling signal — proxy traffic is otherwise invisible to
+        the driver-side ReplicaSet)."""
+        with self._lock:
+            rs = self._routes.get(name)
+        return rs.total_inflight() if rs is not None else 0
+
+    def update_routes(self, name: str, replica_set) -> str:
+        """Controller push: replace (or drop, when None) one
+        deployment's routing snapshot."""
+        with self._lock:
+            if replica_set is None:
+                self._routes.pop(name, None)
+            else:
+                self._routes[name] = replica_set
+        return "ok"
+
+    def address(self):
+        return tuple(self._addr)
+
+    def ping(self) -> str:
+        return "pong"

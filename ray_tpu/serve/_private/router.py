@@ -80,8 +80,11 @@ class ReplicaSet:
 
     # -- assignment ----------------------------------------------------
 
-    def assign(self, method: str, args: tuple, kwargs: dict,
-               model_id: Optional[str] = None) -> ObjectRef:
+    def begin(self, model_id: Optional[str] = None):
+        """Pick a replica (pow-2 / sticky-model) and charge one
+        in-flight request to it. Returns the replica handle; the caller
+        MUST balance with ``end(id(handle))`` when the request
+        resolves (``assign`` wires this automatically)."""
         with self._lock:
             if not self._replicas:
                 raise RuntimeError(
@@ -110,17 +113,44 @@ class ReplicaSet:
             self._inflight[id(chosen)] = \
                 self._inflight.get(id(chosen), 0) + 1
             self.total_assigned += 1
+        return chosen
+
+    def end(self, replica_key: int) -> None:
+        """Release one in-flight charge (ongoing-requests signal for
+        pow-2 and autoscaling)."""
+        with self._lock:
+            if replica_key in self._inflight:
+                self._inflight[replica_key] = max(
+                    0, self._inflight[replica_key] - 1)
+
+    def assign(self, method: str, args: tuple, kwargs: dict,
+               model_id: Optional[str] = None, stream: bool = False):
+        """Route one request. ``stream=True`` calls the replica's
+        streaming endpoint and returns an ObjectRefGenerator whose
+        items land as the replica yields them."""
+        chosen = self.begin(model_id)
+        if stream:
+            gen = chosen.handle_request_streaming.options(
+                num_returns="streaming").remote(method, args, kwargs,
+                                                model_id)
+            self._watch(gen.completed(), id(chosen))
+            return gen
         ref = chosen.handle_request.remote(method, args, kwargs,
                                            model_id)
         self._watch(ref, id(chosen))
         return ref
 
     def _watch(self, ref: ObjectRef, replica_key: int) -> None:
-        """Decrement in-flight when the result lands (ongoing-requests
-        signal for pow-2 and autoscaling)."""
-        def _done(_fut):
-            with self._lock:
-                if replica_key in self._inflight:
-                    self._inflight[replica_key] = max(
-                        0, self._inflight[replica_key] - 1)
-        ref.future().add_done_callback(_done)
+        """Decrement in-flight when the result lands. On the driver the
+        hook rides the owner's completion path (no waiter threads); in
+        a worker (proxy actor / composition) it falls back to a waiter
+        future."""
+        def _done(*_a):
+            self.end(replica_key)
+
+        from ray_tpu._private.worker import try_global_worker
+        w = try_global_worker()
+        if w is not None and hasattr(w, "on_object_ready"):
+            w.on_object_ready(ref.id(), _done)
+        else:
+            ref.future().add_done_callback(_done)

@@ -279,3 +279,135 @@ def test_model_multiplexing_lru_eviction(ray_start_regular):
         assert loads == ["a", "b", "c", "b", "a"], loads
     finally:
         serve.delete("mux-lru")
+
+
+# ---------------------------------------------------------------------------
+# Streaming responses (round-4: generator deployments + chunked HTTP)
+# ---------------------------------------------------------------------------
+
+def test_streaming_handle_sync_generator(serve_instance):
+    @serve.deployment
+    class Stream:
+        def __call__(self, n):
+            for i in range(n):
+                yield i * 3
+
+    handle = serve.run(Stream.bind())
+    gen = handle.options(stream=True).remote(4)
+    assert [ray_tpu.get(r) for r in gen] == [0, 3, 6, 9]
+
+
+def test_streaming_handle_async_generator(serve_instance):
+    @serve.deployment
+    class AStream:
+        async def __call__(self, n):
+            import asyncio
+            for i in range(n):
+                await asyncio.sleep(0.001)
+                yield {"i": i}
+
+    handle = serve.run(AStream.bind())
+    items = [ray_tpu.get(r) for r in
+             handle.options(stream=True).remote(3)]
+    assert items == [{"i": 0}, {"i": 1}, {"i": 2}]
+
+
+def test_streaming_consumes_before_producer_finishes(serve_instance):
+    @serve.deployment
+    class Slow:
+        async def __call__(self, _x=None):
+            import asyncio
+            yield "head"
+            await asyncio.sleep(5.0)
+            yield "tail"
+
+    handle = serve.run(Slow.bind())
+    gen = handle.options(stream=True).remote()
+    t0 = time.perf_counter()
+    first = ray_tpu.get(next(gen))
+    assert first == "head"
+    assert time.perf_counter() - t0 < 4.0
+
+
+def test_async_deployment_unary(serve_instance):
+    @serve.deployment
+    class A:
+        async def __call__(self, x):
+            import asyncio
+            await asyncio.sleep(0.01)
+            return x + 1
+
+    handle = serve.run(A.bind())
+    assert ray_tpu.get(handle.remote(41)) == 42
+
+
+def test_http_streaming_chunked(serve_instance):
+    @serve.deployment
+    class Numbers:
+        def __call__(self, body=None):
+            for i in range(5):
+                yield i
+
+    serve.run(Numbers.bind())
+    host, port = serve.http_address()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/Numbers?stream=1", data=b"",
+        method="POST")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers.get("Content-Type") == "application/x-ndjson"
+        lines = []
+        for raw in resp:
+            raw = raw.strip()
+            if raw:
+                lines.append(json.loads(raw))
+    assert lines == [0, 1, 2, 3, 4]
+
+
+def test_worker_hosted_proxy(serve_instance):
+    @serve.deployment(num_replicas=2)
+    class Echo2:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+    serve.start(http=True, proxy_location="worker")
+    serve.run(Echo2.bind())
+    time.sleep(0.5)      # allow the route push to land
+    host, port = serve.http_address()
+    body = json.dumps({"k": 1}).encode()
+    req = urllib.request.Request(
+        f"http://{host}:{port}/Echo2", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    for _ in range(50):
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert json.loads(resp.read()) == {"echo": {"k": 1}}
+            break
+        except urllib.error.HTTPError as e:
+            if e.code != 404:       # routes not pushed yet
+                raise
+            time.sleep(0.2)
+    else:
+        pytest.fail("worker proxy never learned the route")
+
+    # streaming through the worker-hosted proxy too
+    @serve.deployment
+    class Count3:
+        def __call__(self, body=None):
+            yield from range(3)
+
+    serve.run(Count3.bind())
+    sreq = urllib.request.Request(
+        f"http://{host}:{port}/Count3?stream=1", data=b"",
+        method="POST")
+    for _ in range(50):
+        try:
+            with urllib.request.urlopen(sreq, timeout=30) as resp:
+                got = [json.loads(line) for line in resp if line.strip()]
+            assert got == [0, 1, 2]
+            break
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+            time.sleep(0.2)
+    else:
+        pytest.fail("worker proxy never learned the streaming route")
