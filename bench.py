@@ -352,9 +352,121 @@ def bench_e2e_runtime():
         assert ray_tpu.get(refs)[-1] == m + 1
         out["actor_calls_per_sec"] = round(m / (time.perf_counter() - t0),
                                            1)
+
+        # (d) async actor calls: the event-loop runtime + batched wire
+        # path (one frame per flush both directions) — the analog of
+        # the reference's highest-throughput primitive.
+        @ray_tpu.remote
+        class AsyncCounter:
+            def __init__(self):
+                self.n = 0
+
+            async def ping(self):
+                self.n += 1
+                return self.n
+
+        b = AsyncCounter.remote()
+        ray_tpu.get(b.ping.remote())
+        for _ in range(2):                     # warm the batched path
+            ray_tpu.get([b.ping.remote() for _ in range(1000)])
+        m = 10000
+        t0 = time.perf_counter()
+        refs = [b.ping.remote() for _ in range(m)]
+        ray_tpu.get(refs)
+        out["async_actor_calls_per_sec"] = round(
+            m / (time.perf_counter() - t0), 1)
     except Exception as e:
         print(f"# e2e runtime bench failed: {e!r}", file=sys.stderr)
     finally:
+        try:
+            import ray_tpu
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+    return out
+
+
+def bench_serve():
+    """Serve ingress numbers (round-3 weak #5: serve perf was
+    unmeasured): RPS + p99 through the WORKER-HOSTED HTTP proxy (the
+    deployable topology — parsing/serialization off the driver
+    threads), echo deployment, 4 concurrent closed-loop clients."""
+    out = {}
+    try:
+        import json as _json
+        import threading
+        import urllib.request
+
+        import ray_tpu
+        from ray_tpu import serve
+
+        ray_tpu.init(num_cpus=8, max_process_workers=4)
+
+        @serve.deployment(num_replicas=2)
+        class Echo:
+            def __call__(self, payload):
+                return payload
+
+        serve.start(http=True, proxy_location="worker")
+        serve.run(Echo.bind())
+        host, port = serve.http_address()
+        url = f"http://{host}:{port}/Echo"
+        body = _json.dumps({"v": 1}).encode()
+
+        def one():
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                assert resp.status == 200
+                resp.read()
+
+        # wait for the proxy to learn the route, then warm
+        deadline = time.perf_counter() + 30
+        while True:
+            try:
+                one()
+                break
+            except Exception:
+                if time.perf_counter() > deadline:
+                    raise
+                time.sleep(0.2)
+        for _ in range(20):
+            one()
+
+        n_threads, per = 4, 100
+        lats = []
+        lat_lock = threading.Lock()
+
+        def client():
+            mine = []
+            for _ in range(per):
+                t0 = time.perf_counter()
+                one()
+                mine.append(time.perf_counter() - t0)
+            with lat_lock:
+                lats.extend(mine)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        out["serve_rps"] = round(n_threads * per / dt, 1)
+        out["serve_p99_ms"] = round(
+            float(np.percentile(np.array(lats), 99)) * 1e3, 2)
+    except Exception as e:
+        print(f"# serve bench failed: {e!r}", file=sys.stderr)
+    finally:
+        try:
+            from ray_tpu import serve as _s
+            _s.shutdown()
+        except Exception:
+            pass
         try:
             import ray_tpu
             ray_tpu.shutdown()
@@ -378,10 +490,16 @@ _PEAK_BF16_TFLOPS = {
 
 def bench_model_mfu():
     """Flagship-transformer training-step time and MFU% on the real
-    chip. K steps run inside ONE jitted lax.scan so the tunnel/dispatch
-    round trip (~100 ms on remote-attached chips) amortizes away and
-    the measurement is device time. FLOPs come from XLA's own
-    cost_analysis when available, else the 6·N·T + 12·L·d·T² formula.
+    chip. K steps run inside ONE jitted lax.scan (with the state
+    donated) so the tunnel/dispatch round trip (~100 ms on
+    remote-attached chips) amortizes away and the measurement is
+    device time.
+
+    FLOP accounting is HONEST about causality: the attention term is
+    6·L·d·T·S (HALF the full square) because the flash kernels iterate
+    KV blocks only to the diagonal under causal masking — crediting the
+    full 12·L·d·T·S would flatter MFU by the skipped half. Config and
+    convention recorded in BASELINE.md.
     """
     out = {}
     try:
@@ -395,19 +513,24 @@ def bench_model_mfu():
             return out
         from ray_tpu.models import (
             TransformerConfig, init_state, make_optimizer, make_train_step)
+        from ray_tpu.ops.flash_attention import flash_attention
 
-        # Flagship sizing for MXU utilization: d1024 matmuls, Pallas
-        # flash attention, no remat (single-chip memory fits — remat
-        # re-executes forward FLOPs and deflates MFU ~25%).
+        # Flagship sizing chosen by on-chip sweep (BASELINE.md): d2048
+        # matmuls fill the MXU, Pallas flash attention at 512x512
+        # blocks, no remat (remat re-executes forward FLOPs and
+        # deflates MFU ~25%), state donated through the scan.
         cfg = TransformerConfig(
-            vocab_size=32_768, d_model=1024, n_layers=8, n_heads=16,
-            n_kv_heads=16, d_ff=4096, max_seq_len=1024, remat=False,
+            vocab_size=32_768, d_model=2048, n_layers=8, n_heads=16,
+            n_kv_heads=16, d_ff=8192, max_seq_len=2048, remat=False,
             use_flash=True)
-        batch, seq = 8, 1024
-        k_lo, k_hi = 4, 16
+        batch, seq = 4, 2048
+        block_q = block_k = 512
+        k_lo, k_hi = 2, 8
         tx = make_optimizer(total_steps=1000)
         state = init_state(jax.random.PRNGKey(0), cfg, tx)
-        step = make_train_step(cfg, tx, donate=False)
+        attn = lambda q, k, v, causal=True: flash_attention(  # noqa: E731
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k)
+        step = make_train_step(cfg, tx, attn_fn=attn, donate=False)
         tokens = jnp.asarray(
             np.random.RandomState(0).randint(0, cfg.vocab_size,
                                              (batch, seq), np.int32))
@@ -418,50 +541,49 @@ def bench_model_mfu():
                     s, metrics = step(s, {"tokens": tokens})
                     return s, metrics["loss"]
                 return jax.lax.scan(body, state, None, length=k_steps)
-            return jax.jit(k_step)
+            # donate the 8 GB train state: without donation the scan
+            # holds input AND output state live and the d2048 config
+            # cannot run un-rematerialized
+            return jax.jit(k_step, donate_argnums=(0,))
 
-        def timed(k_jit):
+        def timed(k_jit, st):
             # np.asarray forces the d2h materialization: on
             # remote-attached chips block_until_ready alone can return
             # before the computation actually retires.
             t0 = time.perf_counter()
-            _, losses = k_jit(state, tokens)
+            st2, losses = k_jit(st, tokens)
             losses = np.asarray(losses)
             assert np.isfinite(losses[-1])
-            return time.perf_counter() - t0
+            return time.perf_counter() - t0, st2
 
         lo_jit, hi_jit = make_k(k_lo), make_k(k_hi)
-        timed(lo_jit), timed(hi_jit)                 # compile + warm
+        _, state = timed(lo_jit, state)              # compile + warm
+        _, state = timed(hi_jit, state)
         # Slope timing: (t_hi - t_lo) / (k_hi - k_lo) cancels the fixed
         # per-invocation cost (dispatch + tunnel round trip + transfer).
-        t_lo = min(timed(lo_jit) for _ in range(3))
-        t_hi = min(timed(hi_jit) for _ in range(3))
-        step_s = max(t_hi - t_lo, 1e-9) / (k_hi - k_lo)
+        t_los, t_his = [], []
+        for _ in range(3):
+            dt, state = timed(lo_jit, state)
+            t_los.append(dt)
+            dt, state = timed(hi_jit, state)
+            t_his.append(dt)
+        step_s = max(min(t_his) - min(t_los), 1e-9) / (k_hi - k_lo)
 
-        flops_per_step = None
-        try:
-            cost = jax.jit(step).lower(
-                state, {"tokens": tokens}).compile().cost_analysis()
-            if isinstance(cost, list):
-                cost = cost[0]
-            flops_per_step = float(cost.get("flops", 0.0)) or None
-        except Exception:
-            pass
         n_params = sum(int(np.prod(p.shape))
                        for p in jax.tree.leaves(state.params))
         tokens_per_step = batch * seq
-        analytic = (6.0 * n_params * tokens_per_step
-                    + 12.0 * cfg.n_layers * cfg.d_model
-                    * tokens_per_step * seq)
-        # cost_analysis cannot see inside opaque pallas_call kernels
-        # (the flash-attention FLOPs report as zero), so take the max
-        # of XLA's count and the analytic 6N·T + 12·L·d·T² formula.
-        flops_per_step = max(flops_per_step or 0.0, analytic)
+        # 6·N·T for the parameter matmuls (fwd + bwd) plus the CAUSAL
+        # attention term 6·L·d·T·S — half the dense square, matching
+        # what the kernels actually compute (see docstring).
+        flops_per_step = (6.0 * n_params * tokens_per_step
+                          + 6.0 * cfg.n_layers * cfg.d_model
+                          * tokens_per_step * seq)
 
         peak = next((v for k, v in _PEAK_BF16_TFLOPS.items()
                      if dev.device_kind.startswith(k)), 100.0) * 1e12
         print(f"# mfu: flops/step={flops_per_step:.3e} "
-              f"step={step_s * 1e3:.2f}ms peak={peak:.2e}",
+              f"step={step_s * 1e3:.2f}ms peak={peak:.2e} "
+              f"params={n_params/1e6:.0f}M",
               file=sys.stderr)
         out["model_step_ms"] = round(step_s * 1e3, 2)
         out["model_tokens_per_sec"] = round(batch * seq / step_s, 1)
@@ -522,6 +644,7 @@ def main():
         record["p99_light_vs_baseline"] = round(light_base_us / light_p99_us,
                                                 2)
     record.update(bench_e2e_runtime())
+    record.update(bench_serve())
     record.update(bench_model_mfu())
     print(json.dumps(record))
     print(f"# scheduled {n_scheduled} of {N_TASKS} pending; "
