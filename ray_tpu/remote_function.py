@@ -95,6 +95,13 @@ class _BoundRemoteFunction:
         merged.update(self._overrides)
         return self._parent._remote(args, kwargs, merged)
 
+    def bind(self, *args, **kwargs):
+        """DAG node carrying these options (workflow per-step retry
+        policy rides this: f.options(max_retries=3,
+        retry_exceptions=True).bind(x))."""
+        from ray_tpu.dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
 
 def remote(*args, **kwargs):
     """``@remote`` / ``@remote(num_cpus=...)`` for functions and classes."""
