@@ -104,6 +104,17 @@ class TaskManager:
                 self._lineage[oid] = spec.task_id
             self._lineage_count[spec.task_id] = len(spec.return_ids)
 
+    def add_stream_lineage(self, object_id: ObjectID,
+                           task_id: TaskID) -> None:
+        """Register a streamed item under its producing task's lineage
+        (items are born at delivery, not submission): a lost item then
+        reconstructs by replaying the generator task."""
+        with self._lock:
+            if object_id not in self._lineage:
+                self._lineage[object_id] = task_id
+                self._lineage_count[task_id] = \
+                    self._lineage_count.get(task_id, 0) + 1
+
     def mark_running(self, task_id: TaskID) -> None:
         with self._lock:
             rec = self._tasks.get(task_id)

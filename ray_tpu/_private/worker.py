@@ -920,6 +920,16 @@ class Worker:
             return True       # already being recomputed; piggyback
         logger.info("reconstructing %s for lost object %s",
                     spec.repr_name(), oid)
+        if spec.streaming:
+            # Replay the WHOLE generator: the item-index dedup would
+            # otherwise skip re-delivering the lost item (progress
+            # tracks the highest index ever delivered). Both skip
+            # mechanisms must reset — the owner-side progress AND the
+            # spec-level skip a previous mid-run retry may have left
+            # behind. Re-delivered live items re-store idempotently;
+            # their extra owned-count errs on the over-pinned side.
+            self._stream_progress.pop(spec.task_id, None)
+            spec.stream_skip = 0
         # Purge the stale directory entries so consumers block until
         # the re-execution lands. (The old entries' contained-ref
         # counts are left in place: the fresh result re-registers them,
@@ -1052,8 +1062,8 @@ class Worker:
 
     def _on_stream_item(self, task_id: TaskID, results) -> None:
         """An in-flight streaming generator yielded: materialize the
-        item into the owner's directory (streamed items are owned but
-        carry no lineage — a lost item is not reconstructable)."""
+        item into the owner's directory and register it under the
+        producing task's lineage (a lost item replays the generator)."""
         kind_map = {"inline": "blob", "shm": "shm", "remote": "remote"}
         for oid_b, kind, data, contained in results:
             oid = ObjectID(oid_b)
@@ -1064,6 +1074,9 @@ class Worker:
                 continue   # duplicate delivery from a retried attempt
             self._stream_progress[task_id] = item_no
             self.reference_counter.add_owned_object(oid)
+            # streamed items carry lineage too: a lost item replays the
+            # generator task (see _recover_object's streaming reset)
+            self.task_manager.add_stream_lineage(oid, task_id)
             entry = Entry(kind_map[kind], data,
                           tuple(ObjectID(c) for c in contained))
             self._store_result(oid, entry)

@@ -70,11 +70,31 @@ def _apply_transform(t: MapTransform, blk):
     return blib.block_from_rows(rows_out)
 
 
+def _split_oversized(blk, target_bytes: int):
+    """Dynamic block splitting (reference: target_max_block_size
+    handling in the map-task output path): a map output larger than the
+    target yields as multiple row-sliced blocks, so no single object
+    outgrows the target and downstream stages parallelize over the
+    pieces."""
+    nb = blib.block_size_bytes(blk)
+    rows = blk.num_rows
+    if target_bytes <= 0 or nb <= target_bytes or rows <= 1:
+        yield blk
+        return
+    pieces = min(rows, -(-nb // target_bytes))
+    per = -(-rows // pieces)
+    for start in range(0, rows, per):
+        yield blib.slice_block(blk, start, min(start + per, rows))
+
+
 @ray_tpu.remote
-def _map_chain_task(transforms: List[MapTransform], blk):
+def _map_chain_task(transforms: List[MapTransform], target_bytes: int,
+                    blk):
+    """Streaming map task: yields one block normally, several when the
+    output exceeds ``target_bytes``."""
     for t in transforms:
         blk = _apply_transform(t, blk)
-    return blk
+    yield from _split_oversized(blk, target_bytes)
 
 
 @ray_tpu.remote
@@ -98,10 +118,10 @@ class _MapWorker:
                                  t.batch_format)
             self._transforms.append(t)
 
-    def apply(self, blk):
+    def apply(self, target_bytes: int, blk):
         for t in self._transforms:
             blk = _apply_transform(t, blk)
-        return blk
+        yield from _split_oversized(blk, target_bytes)
 
 
 # -- all-to-all kernels ----------------------------------------------------
@@ -201,12 +221,39 @@ def _sample_task(blk, key: str, k: int):
 # Streaming loop
 # --------------------------------------------------------------------------
 
+def _ref_nbytes(ref) -> int:
+    """Stored size of a resolved driver-owned block ref (0 when
+    unknown): the byte signal the backpressure budgets run on — block
+    sizes are known at ref-resolution time from the owner's directory,
+    no block fetch involved."""
+    from ray_tpu._private.worker import try_global_worker
+    w = try_global_worker()
+    if w is None or not hasattr(w, "memory_store"):
+        return 0
+    try:
+        entry = w.memory_store.get(ref.id(), timeout=0)
+    except TimeoutError:
+        return 0
+    try:
+        if entry.kind in ("shm", "remote"):
+            return int(entry.data[1])
+        if entry.kind == "blob":
+            return len(entry.data)
+    except Exception:
+        pass
+    return 0
+
+
 class _MapRuntime:
-    def __init__(self, stage: MapStage, max_in_flight: int):
+    def __init__(self, stage: MapStage, max_in_flight: int,
+                 target_block_bytes: int):
         self.stage = stage
-        self.inputs: deque = deque()
-        self.in_flight: Dict[Any, int] = {}       # ref -> seq
-        self.ready: Dict[int, Any] = {}           # seq -> ref (completed)
+        self.target_block_bytes = target_block_bytes
+        self.inputs: deque = deque()              # (ref, seq, nbytes)
+        self.in_flight: Dict[Any, int] = {}       # done-marker ref -> seq
+        self._gen_task: Dict[int, Any] = {}       # seq -> stream TaskID
+        self._inflight_bytes: Dict[Any, int] = {}  # done ref -> input bytes
+        self.ready: Dict[int, List] = {}          # seq -> [refs] in order
         self.next_in_seq = 0
         self.next_out_seq = 0
         self.input_done = False
@@ -214,6 +261,22 @@ class _MapRuntime:
         self.actors: List = []
         self.actor_busy: Dict[int, int] = {}      # actor idx -> in-flight
         self._ref_actor: Dict[Any, int] = {}
+
+    def add_input(self, ref, seq: int) -> None:
+        self.inputs.append((ref, seq, _ref_nbytes(ref)))
+
+    def queued_bytes(self) -> int:
+        """Bytes parked at this stage (queued inputs + inputs of
+        running tasks): the signal upstream gates on."""
+        return (sum(nb for _r, _s, nb in self.inputs)
+                + sum(self._inflight_bytes.values()))
+
+    def ready_bytes(self) -> int:
+        """Bytes of completed outputs not yet handed downstream — the
+        terminal stage gates its own launches on this (consumer-paced
+        byte backpressure)."""
+        return sum(_ref_nbytes(r)
+                   for refs in self.ready.values() for r in refs)
 
     def ensure_actors(self):
         if self.stage.uses_actors and not self.actors:
@@ -229,15 +292,22 @@ class _MapRuntime:
                 for _ in range(n)]
             self.actor_busy = {i: 0 for i in range(len(self.actors))}
 
-    def launch(self):
+    def launch(self, budget_ok=None):
+        """Start tasks while the count cap AND the downstream byte
+        budget allow (``budget_ok`` closes over the downstream stage's
+        queued bytes — memory-aware backpressure)."""
         self.ensure_actors()
         while self.inputs and len(self.in_flight) < self.max_in_flight:
-            blk_ref, seq = self.inputs.popleft()
+            if budget_ok is not None and not budget_ok():
+                return
+            blk_ref, seq, nbytes = self.inputs.popleft()
             if self.stage.uses_actors:
                 idx = min(self.actor_busy, key=self.actor_busy.get)
-                ref = self.actors[idx].apply.remote(blk_ref)
+                gen = self.actors[idx].apply.options(
+                    num_returns="streaming").remote(
+                        self.target_block_bytes, blk_ref)
                 self.actor_busy[idx] += 1
-                self._ref_actor[ref] = idx
+                self._ref_actor[gen.completed()] = idx
             else:
                 kw = {}
                 res = self.stage.resources
@@ -245,21 +315,35 @@ class _MapRuntime:
                     kw["num_cpus"] = res["CPU"]
                 if "TPU" in res:
                     kw["num_tpus"] = res["TPU"]
-                ref = _map_chain_task.options(**kw).remote(
-                    self.stage.transforms, blk_ref)
-            self.in_flight[ref] = seq
+                gen = _map_chain_task.options(
+                    num_returns="streaming", **kw).remote(
+                        self.stage.transforms, self.target_block_bytes,
+                        blk_ref)
+            done_ref = gen.completed()
+            self.in_flight[done_ref] = seq
+            self._gen_task[seq] = done_ref.id().task_id()
+            self._inflight_bytes[done_ref] = nbytes
 
     def complete(self, ref):
+        """A map task's stream finished: expand its item refs (split
+        outputs land as separate driver-owned blocks, indices 2..)."""
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.object_ref import ObjectRef
         seq = self.in_flight.pop(ref)
+        self._inflight_bytes.pop(ref, None)
         idx = self._ref_actor.pop(ref, None)
         if idx is not None:
             self.actor_busy[idx] -= 1
-        self.ready[seq] = ref
+        task_id = self._gen_task.pop(seq)
+        count = ray_tpu.get(ref)      # raises the task's error, if any
+        self.ready[seq] = [
+            ObjectRef(ObjectID.from_index(task_id, i + 2))
+            for i in range(count)]
 
     def pop_ready_in_order(self):
         out = []
         while self.next_out_seq in self.ready:
-            out.append(self.ready.pop(self.next_out_seq))
+            out.extend(self.ready.pop(self.next_out_seq))
             self.next_out_seq += 1
         return out
 
@@ -278,13 +362,35 @@ class _MapRuntime:
 
 
 class StreamingExecutor:
-    """Drives a PhysicalPlan; iterate over output block refs."""
+    """Drives a PhysicalPlan; iterate over output block refs.
 
-    def __init__(self, plan: PhysicalPlan, *, max_in_flight: int = 8,
+    Backpressure is BYTE-aware (reference: reservation-based
+    backpressure policies + target_max_block_size): each stage's
+    launches are gated on the DOWNSTREAM stage's queued bytes staying
+    under a per-stage budget (derived from the object-store capacity
+    unless pinned via DataContext), reads are gated on the first
+    stage's queue, and map outputs above ``target_max_block_size``
+    split into multiple blocks inside the producing task.
+    """
+
+    def __init__(self, plan: PhysicalPlan, *, max_in_flight=None,
                  name: str = "dataset"):
+        from ray_tpu.data.context import DataContext
+        ctx = DataContext.get_current()
         self._plan = plan
-        self._max_in_flight = max_in_flight
+        self._max_in_flight = max_in_flight or ctx.max_in_flight
+        self._target_block_bytes = ctx.target_max_block_size
+        self._budget_override = ctx.per_stage_memory_budget
         self._name = name
+
+    def _per_stage_budget(self, n_stages: int) -> int:
+        if self._budget_override:
+            return self._budget_override
+        from ray_tpu._private.config import get_config
+        store = get_config().object_store_memory_bytes
+        # a quarter of the store shared across stages, floor 8 MiB —
+        # the rest is headroom for outputs, consumers, and other users
+        return max(8 * 1024 * 1024, int(0.25 * store) // max(1, n_stages))
 
     def output_refs(self) -> Iterator[Any]:
         plan = self._plan
@@ -318,17 +424,37 @@ class StreamingExecutor:
         pipeline: List = []
         for st in map_stages:
             if isinstance(st, MapStage):
-                rt = _MapRuntime(st, self._max_in_flight)
+                rt = _MapRuntime(st, self._max_in_flight,
+                                 self._target_block_bytes)
                 runtimes.append(rt)
                 pipeline.append(rt)
             elif isinstance(st, LimitStage):
                 limit_remaining[id(st)] = st.n
                 pipeline.append(st)
 
+        budget = self._per_stage_budget(max(1, len(runtimes)))
+        # each stage's launches gate on its DOWNSTREAM stage's queued
+        # bytes; reads gate on the FIRST stage's queue
+        downstream_of: Dict[int, Optional[_MapRuntime]] = {}
+        for i, rt in enumerate(runtimes):
+            downstream_of[id(rt)] = (runtimes[i + 1]
+                                     if i + 1 < len(runtimes) else None)
+
+        def budget_ok_for(rt: _MapRuntime):
+            ds = downstream_of.get(id(rt))
+            if ds is None:
+                # terminal stage: gate on its own completed-unconsumed
+                # output bytes (the consumer's pace, in bytes)
+                return lambda: rt.ready_bytes() < budget
+            return lambda: ds.queued_bytes() < budget
+
         read_in_flight: Dict[Any, int] = {}
         read_seq = 0
         emitted: List[Any] = []
         stop = False
+
+        def reads_allowed() -> bool:
+            return not runtimes or runtimes[0].queued_bytes() < budget
 
         def feed_first(ref):
             nonlocal stop
@@ -339,7 +465,7 @@ class StreamingExecutor:
             tgt = next((it for it in pipeline
                         if isinstance(it, _MapRuntime)), None)
             if tgt is not None:
-                tgt.inputs.append((ref, tgt.next_in_seq))
+                tgt.add_input(ref, tgt.next_in_seq)
                 tgt.next_in_seq += 1
             else:
                 emitted.append(ref)
@@ -348,18 +474,19 @@ class StreamingExecutor:
         out_queue: deque = deque()
         try:
             while True:
-                # 1. launch reads
+                # 1. launch reads (count cap + first-stage byte budget)
                 while (pending_reads
                        and len(read_in_flight) < self._max_in_flight
+                       and reads_allowed()
                        and not stop):
                     fn = pending_reads.popleft()
                     read_in_flight[_read_task.remote(fn)] = read_seq
                     read_seq += 1
                 while source:
                     feed_first(source.popleft())
-                # 2. launch map work
+                # 2. launch map work (downstream byte budget)
                 for rt in runtimes:
-                    rt.launch()
+                    rt.launch(budget_ok_for(rt))
                 # 3. wait for anything
                 all_refs = (list(read_in_flight)
                             + [r for rt in runtimes for r in rt.in_flight])
@@ -397,8 +524,7 @@ class StreamingExecutor:
                                 tgt = pipeline[j]
                                 break
                         if tgt is not None:
-                            tgt.inputs.append(
-                                (ref_out, tgt.next_in_seq))
+                            tgt.add_input(ref_out, tgt.next_in_seq)
                             tgt.next_in_seq += 1
                         else:
                             emitted.append(ref_out)

@@ -33,7 +33,8 @@ from ray_tpu.data._internal.plan import (
 
 
 class Dataset:
-    def __init__(self, op: LogicalOp, max_in_flight: int = 8):
+    def __init__(self, op: LogicalOp, max_in_flight=None):
+        # None -> DataContext.max_in_flight at execution time
         self._op = op
         self._max_in_flight = max_in_flight
 

@@ -156,3 +156,84 @@ def test_iter_torch_and_jax_batches(ray_start_regular):
         assert isinstance(batch["id"], jax.Array)
         total += float(batch["id"].sum())
     assert total == float(np.arange(10).sum())
+
+
+# ---------------------------------------------------------------------------
+# Round-4: memory-aware backpressure + dynamic block splitting
+# (reference: backpressure_policy/ + target_max_block_size)
+# ---------------------------------------------------------------------------
+
+def test_oversized_map_output_splits(ray_start_regular):
+    from ray_tpu.data.context import DataContext
+    ctx = DataContext.get_current()
+    old = ctx.target_max_block_size
+    ctx.target_max_block_size = 256 * 1024      # 256 KiB
+    try:
+        # one 100-row input block; map inflates each row to ~32 KiB ->
+        # ~3.2 MB output, must split into >= 2 blocks (~13)
+        ds = ray_tpu.data.range(100).repartition(1).map_batches(
+            lambda b: {"id": b["id"],
+                       "blob": [np.zeros(8192, np.float32).tobytes()
+                                for _ in b["id"]]},
+            batch_size=None)
+        blocks = list(ds.iter_blocks())
+        assert len(blocks) >= 2, len(blocks)
+        assert sum(b.num_rows for b in blocks) == 100
+        from ray_tpu.data import block as blib
+        for b in blocks:
+            assert blib.block_size_bytes(b) <= 2 * ctx.target_max_block_size
+    finally:
+        ctx.target_max_block_size = old
+
+
+def test_streams_larger_than_store_without_spill_thrash():
+    """Total dataset bytes >> object store capacity: byte-aware
+    backpressure keeps queued blocks under budget, so consuming the
+    stream incrementally never forces the store into spill-thrash."""
+    import ray_tpu as rt
+    from ray_tpu.data.context import DataContext
+    w = rt.init(num_cpus=4, object_store_memory=8 * 1024 * 1024,
+                max_process_workers=2)
+    ctx = DataContext.get_current()
+    old_budget = ctx.per_stage_memory_budget
+    ctx.per_stage_memory_budget = 1024 * 1024       # 1 MiB per stage
+    try:
+        n_blocks, rows_per = 40, 64
+        # each block ~= 64 rows x 4 KiB = 256 KiB; total ~10 MB > 8 MB cap
+        ds = rt.data.range(n_blocks * rows_per).repartition(
+            n_blocks).map_batches(
+            lambda b: {"id": b["id"],
+                       "payload": [b"z" * 4096 for _ in b["id"]]},
+            batch_size=None)
+        rows = 0
+        for blk in ds.iter_blocks():
+            rows += blk.num_rows       # consume + drop each block
+        assert rows == n_blocks * rows_per
+        spilled = w.shm_store.num_spilled
+        assert spilled <= 3, f"spill-thrash: {spilled} spills"
+    finally:
+        ctx.per_stage_memory_budget = old_budget
+        rt.shutdown()
+
+
+def test_backpressure_bounds_queued_bytes(ray_start_regular):
+    """The producer must NOT race ahead of a slow consumer stage: with
+    a tiny budget, the fast stage's completed blocks stay bounded."""
+    import time as _t
+    from ray_tpu.data.context import DataContext
+    ctx = DataContext.get_current()
+    old_budget = ctx.per_stage_memory_budget
+    ctx.per_stage_memory_budget = 512 * 1024
+    try:
+        def slow_pass(b):
+            _t.sleep(0.05)
+            return b
+
+        ds = ray_tpu.data.range(2000).repartition(20).map_batches(
+            lambda b: {"id": b["id"],
+                       "pad": [b"x" * 2048 for _ in b["id"]]},
+            batch_size=None).map_batches(slow_pass, batch_size=None)
+        total = sum(blk.num_rows for blk in ds.iter_blocks())
+        assert total == 2000
+    finally:
+        ctx.per_stage_memory_budget = old_budget
