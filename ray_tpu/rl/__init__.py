@@ -12,10 +12,22 @@ from ray_tpu.rl.env import CartPoleVec, VectorEnv, make_env, register_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rl.dqn import DQN, DQNConfig, ReplayBuffer, init_q_params
 from ray_tpu.rl.ppo import PPO, PPOConfig, init_policy_params
+from ray_tpu.rl.multi_agent import (
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    MultiAgentVectorEnv,
+    TwoTargetsEnv,
+    make_multi_agent_env,
+    register_multi_agent_env,
+)
 
 __all__ = [
     "PPO", "PPOConfig", "DQN", "DQNConfig", "ReplayBuffer",
     "EnvRunner", "EnvRunnerGroup", "VectorEnv",
     "CartPoleVec", "make_env", "register_env", "init_policy_params",
     "init_q_params",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "MultiAgentVectorEnv",
+    "MultiAgentEnvRunner", "TwoTargetsEnv", "make_multi_agent_env",
+    "register_multi_agent_env",
 ]
