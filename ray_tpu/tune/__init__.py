@@ -9,6 +9,7 @@ from ray_tpu.tune.schedulers import (
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -29,7 +30,7 @@ from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
 __all__ = [
     "ASHAScheduler", "BasicVariantGenerator", "Checkpoint",
     "FIFOScheduler", "HyperBandScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "ResultGrid", "Searcher", "TPESearch",
+    "PB2", "PopulationBasedTraining", "ResultGrid", "Searcher", "TPESearch",
     "Trial", "TrialScheduler", "TuneConfig", "Tuner", "choice",
     "get_checkpoint", "grid_search", "loguniform", "quniform", "randint",
     "report", "sample_from", "uniform",

@@ -238,3 +238,117 @@ class PopulationBasedTraining(TrialScheduler):
 
     def exploit_info(self, trial):
         return self._exploit.pop(trial.trial_id, None)
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference:
+    ``python/ray/tune/schedulers/pb2.py``): PBT's exploit step, but the
+    exploited trial's new hyperparameters come from a Gaussian-process
+    model over (config → score improvement) observations instead of
+    random perturbation — model-based, schedule-aware search within
+    ``hyperparam_bounds``.
+
+    The GP is a small exact RBF regressor (population-scale data: tens
+    of points), maximized by UCB over sampled candidates; categorical/
+    non-bounded params fall back to PBT mutation semantics when listed
+    in ``hyperparam_mutations``.
+    """
+
+    def __init__(self, *, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 1.5,
+                 num_candidates: int = 256,
+                 time_attr: str = "training_iteration",
+                 seed: Optional[int] = None):
+        super().__init__(metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction,
+                         time_attr=time_attr, seed=seed)
+        self.bounds: Dict[str, tuple] = dict(hyperparam_bounds or {})
+        if not self.bounds:
+            raise ValueError("PB2 needs hyperparam_bounds="
+                             "{name: (low, high), ...}")
+        self.kappa = ucb_kappa
+        self.num_candidates = num_candidates
+        self._keys = sorted(self.bounds)
+        # GP data: normalized config vector -> score delta over one
+        # perturbation interval
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+        self._prev_score: Dict[Any, float] = {}
+
+    def _normalize(self, config: Dict) -> List[float]:
+        out = []
+        for k in self._keys:
+            lo, hi = self.bounds[k]
+            v = float(config.get(k, lo))
+            out.append((v - lo) / max(hi - lo, 1e-12))
+        return out
+
+    def on_trial_result(self, trial, result: Dict) -> str:
+        t = int(result.get(self.time_attr, 0))
+        if t and t % self.interval == 0:
+            # record (config, delta score over the interval) for the GP
+            score = self._score_of(result)
+            prev = self._prev_score.get(trial.trial_id)
+            if prev is not None:
+                self._X.append(self._normalize(trial.config))
+                self._y.append(score - prev)
+                if len(self._X) > 200:       # bound the exact-GP solve
+                    self._X = self._X[-200:]
+                    self._y = self._y[-200:]
+            self._prev_score[trial.trial_id] = score
+        decision = super().on_trial_result(trial, result)
+        if decision == EXPLOIT:
+            # the trial restarts from the SOURCE's checkpoint: its next
+            # interval delta would otherwise include the checkpoint
+            # score jump and poison the GP's training targets
+            self._prev_score.pop(trial.trial_id, None)
+        return decision
+
+    def _mutate(self, config: Dict) -> Dict:
+        """Called by PBT's exploit path on the SOURCE trial's config:
+        replace the bounded params with the GP-UCB argmax."""
+        import numpy as np
+        new = dict(config)
+        rng = self._rng
+        cand = np.asarray(
+            [[rng.random() for _ in self._keys]
+             for _ in range(self.num_candidates)])
+        if len(self._y) >= 3:
+            X = np.asarray(self._X)
+            y = np.asarray(self._y, dtype=float)
+            y_mean, y_std = y.mean(), max(y.std(), 1e-9)
+            yn = (y - y_mean) / y_std
+            ell, noise = 0.2, 1e-3
+
+            def rbf(a, b):
+                d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+                return np.exp(-d2 / (2 * ell * ell))
+
+            K = rbf(X, X) + noise * np.eye(len(X))
+            try:
+                L = np.linalg.cholesky(K)
+                alpha = np.linalg.solve(
+                    L.T, np.linalg.solve(L, yn))
+                Ks = rbf(cand, X)
+                mu = Ks @ alpha
+                v = np.linalg.solve(L, Ks.T)
+                var = np.maximum(1.0 - (v ** 2).sum(0), 1e-12)
+                ucb = mu + self.kappa * np.sqrt(var)
+                best = cand[int(np.argmax(ucb))]
+            except np.linalg.LinAlgError:
+                best = cand[rng.randrange(len(cand))]
+        else:
+            # cold start: explore uniformly within bounds
+            best = cand[rng.randrange(len(cand))]
+        for k, u in zip(self._keys, best):
+            lo, hi = self.bounds[k]
+            val = lo + float(u) * (hi - lo)
+            if isinstance(config.get(k), int):
+                val = int(round(val))
+            new[k] = val
+        return new
