@@ -26,7 +26,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "get_actor", "ObjectRef", "ActorClass", "ActorHandle",
     "RemoteFunction", "cluster_resources", "available_resources",
-    "exceptions", "nodes", "timeline",
+    "exceptions", "nodes", "timeline", "dump_stacks",
 ]
 
 
@@ -103,3 +103,12 @@ def timeline() -> List[dict]:
     """Chrome-trace events for completed tasks (reference: ray timeline)."""
     from ray_tpu._private.events import get_task_events
     return get_task_events()
+
+
+def dump_stacks(node_id: Optional[str] = None) -> dict:
+    """Live Python stacks per node (host process + every process
+    worker) — the on-demand py-spy-style host profiler. ``node_id``
+    (hex) restricts to one node."""
+    from ray_tpu._private.ids import NodeID
+    nid = NodeID.from_hex(node_id) if node_id else None
+    return _worker_mod.global_worker().dump_stacks(nid)

@@ -1312,6 +1312,10 @@ class NodeManagerGroup:
             if evt is not None:
                 evt.set()
             return
+        if op == "stacks":
+            from ray_tpu._private.profiling import deliver_stack_reply
+            deliver_stack_reply(worker, reply[1])
+            return
         if op == "done":
             _, task_id_b, results, err_blob = reply[:4]
             timings = reply[4] if len(reply) > 4 else None

@@ -104,6 +104,7 @@ class RayletServer:
         self.server.register("register_owner", self._register_owner)
         self.server.register("stats", lambda ctx: self.stats())
         self.server.register("read_logs", self._handle_read_logs)
+        self.server.register("dump_stacks", self._handle_dump_stacks)
         self.server.register("submit", self._handle_submit)
         self.server.register("submit_batch", self._handle_submit_batch)
         self.server.register("kill_actor", self._handle_kill_actor)
@@ -196,6 +197,16 @@ class RayletServer:
                 pass
             worker.kill()
             self.worker_pool.remove_worker(worker)
+
+    def _handle_dump_stacks(self, ctx) -> dict:
+        """On-demand host profiling (reference: the dashboard
+        reporter's py-spy endpoint): live Python stacks for this raylet
+        process and every process worker it manages."""
+        from ray_tpu._private.profiling import (dump_all_stacks,
+                                                gather_pool_stacks)
+        out = {"raylet": dump_all_stacks()}
+        out.update(gather_pool_stacks(self.worker_pool))
+        return out
 
     def _handle_read_logs(self, ctx, cursor):
         """Per-node agent log plane: incremental tail over this node's
@@ -393,6 +404,10 @@ class RayletServer:
             for r in reply[1]:
                 self._handle_worker_reply(worker, r)
             return
+        if op == "stacks":
+            from ray_tpu._private.profiling import deliver_stack_reply
+            deliver_stack_reply(worker, reply[1])
+            return
         if op == "stream":
             # streaming generator item: seal big items locally, relay
             # the (location) descriptors to the owner
@@ -512,8 +527,13 @@ class RayletServer:
 
     def _metric_stats(self) -> dict:
         """Small per-node stats dict shipped with each heartbeat; the
-        driver exports these as per-node Prometheus series."""
+        driver exports these as per-node Prometheus series. The
+        ``worker_rss`` sub-dict becomes the per-worker RSS series and
+        the dashboard nodes table's memory column (reporter-agent
+        role)."""
+        from ray_tpu._private.profiling import worker_rss_map
         store = self.shm_store.stats()
+        rss = worker_rss_map(self.worker_pool)
         with self._lock:
             return {
                 "queued_tasks": len(self._dispatch_queue),
@@ -523,6 +543,8 @@ class RayletServer:
                 "store_used_bytes": store["used_bytes"],
                 "store_num_objects": store["num_objects"],
                 "workers": self.worker_pool.stats()["total"],
+                "workers_rss_bytes": sum(rss.values()),
+                "worker_rss": rss,
             }
 
     # -- lifecycle -----------------------------------------------------

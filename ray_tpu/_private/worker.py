@@ -431,15 +431,22 @@ class Worker:
             "Per-node raylet stats (queued/running tasks, actors, "
             "store bytes/objects, workers, pulls)",
             tag_keys=("node", "stat"))
+        rss_g = metrics.Gauge(
+            "ray_tpu_worker_rss_bytes",
+            "Per-worker resident set size (reporter-agent role)",
+            tag_keys=("node", "worker"))
 
         def collect():
             if self._shutdown:
                 return
+            from ray_tpu._private.profiling import (process_rss_bytes,
+                                                    worker_rss_map)
             # Rebuild from live state each scrape: dead nodes' series
             # vanish instead of exporting their last values forever.
             avail_g.clear()
             total_g.clear()
             stat_g.clear()
+            rss_g.clear()
             for nid, res in self.node_group.cluster_resources.nodes():
                 node = nid.hex()[:12]
                 for k, v in res.total.items():
@@ -447,17 +454,28 @@ class Worker:
                 for k, v in res.available.items():
                     avail_g.set(v, tags={"node": node, "resource": k})
             head = self.node_group.head_node_id
+            head_hex = head.hex()[:12]
             store = self.shm_store.stats()
+            head_rss = {}
+            raylet = self.node_group._raylets.get(head)
+            if raylet is not None:
+                head_rss = worker_rss_map(raylet.worker_pool)
             heads = {
                 "queued_tasks": len(self.node_group._to_schedule),
                 "running_tasks": len(self.node_group._running),
                 "actors": len(self.node_group._actor_workers),
                 "store_used_bytes": store["used_bytes"],
                 "store_num_objects": store["num_objects"],
+                "workers_rss_bytes": sum(head_rss.values()),
             }
             for k, v in heads.items():
                 stat_g.set(float(v),
-                           tags={"node": head.hex()[:12], "stat": k})
+                           tags={"node": head_hex, "stat": k})
+            for whex, rss in head_rss.items():
+                rss_g.set(float(rss), tags={"node": head_hex,
+                                            "worker": whex})
+            rss_g.set(float(process_rss_bytes()),
+                      tags={"node": head_hex, "worker": "driver"})
             stale = 3 * get_config().health_check_period_ms / 1000.0
             now = time.time()
             for nid, (ts, stats) in list(self.node_stats.items()):
@@ -465,6 +483,13 @@ class Worker:
                     self.node_stats.pop(nid, None)   # stopped beating
                     continue
                 for k, v in stats.items():
+                    if isinstance(v, dict):
+                        if k == "worker_rss":
+                            for whex, rss in v.items():
+                                rss_g.set(float(rss),
+                                          tags={"node": nid.hex()[:12],
+                                                "worker": whex})
+                        continue
                     stat_g.set(float(v), tags={"node": nid.hex()[:12],
                                                "stat": k})
 
@@ -1601,6 +1626,34 @@ class Worker:
             from ray_tpu._private.object_store import (
                 sweep_orphan_segments)
             sweep_orphan_segments(self.session)
+
+    def dump_stacks(self, node_id: Optional[NodeID] = None
+                    ) -> Dict[str, Dict[str, str]]:
+        """Live Python stacks across the cluster (reference: the
+        dashboard reporter's py-spy endpoint): per node, the host
+        process ("driver"/"raylet") plus each process worker. Restrict
+        to one node with ``node_id``."""
+        from ray_tpu._private.profiling import (dump_all_stacks,
+                                                gather_pool_stacks)
+        out: Dict[str, Dict[str, str]] = {}
+        with self.node_group._lock:
+            raylets = dict(self.node_group._raylets)
+            remotes = dict(self.node_group._remote_nodes)
+        for nid, raylet in raylets.items():
+            if node_id is not None and nid != node_id:
+                continue
+            entry = {"driver": dump_all_stacks()}
+            entry.update(gather_pool_stacks(raylet.worker_pool))
+            out[nid.hex()[:12]] = entry
+        for nid, handle in remotes.items():
+            if node_id is not None and nid != node_id:
+                continue
+            try:
+                out[nid.hex()[:12]] = handle.client.call(
+                    "dump_stacks", timeout=10)
+            except Exception as e:
+                out[nid.hex()[:12]] = {"error": repr(e)}
+        return out
 
     def cluster_resources(self) -> Dict[str, float]:
         total: Dict[str, float] = {}

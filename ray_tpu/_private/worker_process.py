@@ -754,6 +754,32 @@ def worker_main(conn, session: str, max_inline_bytes: int,
         with send_lock:
             conn.send(reply)
 
+    # On-demand stack dumps MUST work while the loop thread is busy
+    # executing a task (that is when you want them), so the request
+    # arrives as SIGUSR1 — not a pipe message the busy loop would never
+    # read. The handler only sets an event; a dedicated responder
+    # thread does the dump + send (signal handlers can't take the send
+    # lock safely).
+    _stack_req = threading.Event()
+
+    def _respond_stacks() -> None:
+        from ray_tpu._private.profiling import dump_all_stacks
+        while True:
+            _stack_req.wait()
+            _stack_req.clear()
+            try:
+                send(("stacks", dump_all_stacks()))
+            except Exception:
+                return
+    try:
+        import signal as _signal
+        _signal.signal(_signal.SIGUSR1,
+                       lambda *_a: _stack_req.set())
+        threading.Thread(target=_respond_stacks, daemon=True,
+                         name="rtpu-stack-responder").start()
+    except (ValueError, OSError):
+        pass    # non-main thread / exotic platform: pipe path only
+
     try:
         while True:
             try:
@@ -777,6 +803,10 @@ def worker_main(conn, session: str, max_inline_bytes: int,
                 # owner-core address (creates the core on first ask).
                 send(("core_addr",
                       worker_core.get_worker_core().address))
+            elif op == "dump_stacks":
+                # on-demand host-side profiling (py-spy role)
+                from ray_tpu._private.profiling import dump_all_stacks
+                send(("stacks", dump_all_stacks()))
             elif op == "ping":
                 send(("pong",))
     finally:
@@ -799,6 +829,16 @@ def _standalone_main() -> None:
     import argparse
 
     from multiprocessing.connection import Client
+
+    # A stack-dump SIGUSR1 can arrive the moment the hub registration
+    # lands — BEFORE worker_main installs the real handler. The default
+    # disposition would terminate the starting worker; ignore until the
+    # real handler takes over.
+    try:
+        import signal as _signal
+        _signal.signal(_signal.SIGUSR1, _signal.SIG_IGN)
+    except (ValueError, OSError):
+        pass
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--address", required=True)

@@ -254,6 +254,7 @@ class _MapRuntime:
         self._gen_task: Dict[int, Any] = {}       # seq -> stream TaskID
         self._inflight_bytes: Dict[Any, int] = {}  # done ref -> input bytes
         self.ready: Dict[int, List] = {}          # seq -> [refs] in order
+        self._ready_nbytes: Dict[int, int] = {}   # seq -> output bytes
         self.next_in_seq = 0
         self.next_out_seq = 0
         self.input_done = False
@@ -274,9 +275,10 @@ class _MapRuntime:
     def ready_bytes(self) -> int:
         """Bytes of completed outputs not yet handed downstream — the
         terminal stage gates its own launches on this (consumer-paced
-        byte backpressure)."""
-        return sum(_ref_nbytes(r)
-                   for refs in self.ready.values() for r in refs)
+        byte backpressure). Sizes are cached at completion (immutable
+        once stored), so the budget check is O(ready), not O(ready)
+        store lookups."""
+        return sum(self._ready_nbytes.values())
 
     def ensure_actors(self):
         if self.stage.uses_actors and not self.actors:
@@ -336,14 +338,16 @@ class _MapRuntime:
             self.actor_busy[idx] -= 1
         task_id = self._gen_task.pop(seq)
         count = ray_tpu.get(ref)      # raises the task's error, if any
-        self.ready[seq] = [
-            ObjectRef(ObjectID.from_index(task_id, i + 2))
-            for i in range(count)]
+        refs = [ObjectRef(ObjectID.from_index(task_id, i + 2))
+                for i in range(count)]
+        self.ready[seq] = refs
+        self._ready_nbytes[seq] = sum(_ref_nbytes(r) for r in refs)
 
     def pop_ready_in_order(self):
         out = []
         while self.next_out_seq in self.ready:
             out.extend(self.ready.pop(self.next_out_seq))
+            self._ready_nbytes.pop(self.next_out_seq, None)
             self.next_out_seq += 1
         return out
 

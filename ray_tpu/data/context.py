@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Optional
+from typing import ClassVar, Optional
 
 
 @dataclasses.dataclass
@@ -26,8 +26,8 @@ class DataContext:
     # Fallback count cap on concurrently running tasks per stage.
     max_in_flight: int = 8
 
-    _current: "Optional[DataContext]" = None
-    _lock = threading.Lock()
+    _current: ClassVar[Optional["DataContext"]] = None
+    _lock: ClassVar[threading.Lock] = threading.Lock()
 
     @classmethod
     def get_current(cls) -> "DataContext":

@@ -304,6 +304,43 @@ def _cmd_workflows(args) -> int:
     return 0
 
 
+def _cmd_stack(args) -> int:
+    """Live Python stacks from every raylet node (or one): the
+    py-spy-style on-demand host profiler, served by each raylet's
+    ``dump_stacks`` RPC."""
+    from ray_tpu._private.gcs_client import GcsClient
+    from ray_tpu._private.rpc import RpcClient
+    if getattr(args, "token", ""):
+        from ray_tpu._private import rpc as _rpc
+        _rpc.set_session_token(args.token)
+    host, port = args.address.rsplit(":", 1)
+    gcs = GcsClient((host, int(port)))
+    try:
+        shown = 0
+        for info in gcs.get_all_node_info():
+            hexid = info.node_id.hex()
+            if args.node and not hexid.startswith(args.node):
+                continue
+            if not info.alive or info.rpc_addr is None:
+                continue
+            client = RpcClient(tuple(info.rpc_addr))
+            try:
+                stacks = client.call("dump_stacks", timeout=15)
+            finally:
+                client.close()
+            for proc, text in stacks.items():
+                print(f"===== node {hexid[:12]} {proc} =====")
+                print(text)
+                shown += 1
+        if not shown:
+            print("no addressable raylet matched (head-node stacks: "
+                  "ray_tpu.dump_stacks() from the driver)")
+            return 1
+        return 0
+    finally:
+        gcs.close()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -330,6 +367,15 @@ def main(argv=None) -> int:
     sp = sub.add_parser("stop", help="terminate cluster processes")
     sp.add_argument("--session", default="")
     sp.set_defaults(fn=_cmd_stop)
+
+    sp = sub.add_parser("stack",
+                        help="live Python stacks from raylet nodes "
+                             "(host profiler)")
+    sp.add_argument("--address", required=True, help="GCS host:port")
+    sp.add_argument("--node", default="",
+                    help="hex node-id prefix to restrict to")
+    sp.add_argument("--token", default="", help="session token")
+    sp.set_defaults(fn=_cmd_stack)
 
     sp = sub.add_parser("workflows", help="list workflows")
     sp.add_argument("--storage", default=None)

@@ -22,17 +22,29 @@ def list_nodes() -> List[dict]:
     for info in w.gcs.get_all_node_info():
         res = cluster.get(info.node_id)
         stats = w.node_stats.get(info.node_id)
+        stats_d = dict(stats[1]) if stats else {}
+        is_head = info.node_id == w.node_group.head_node_id
+        if is_head and not stats_d:
+            # the head has no heartbeat-to-self: fill its worker RSS
+            # live so the nodes table shows per-worker memory for
+            # every node (reporter-agent role)
+            from ray_tpu._private.profiling import worker_rss_map
+            raylet = w.node_group._raylets.get(info.node_id)
+            if raylet is not None:
+                rss = worker_rss_map(raylet.worker_pool)
+                stats_d = {"worker_rss": rss,
+                           "workers_rss_bytes": sum(rss.values())}
         out.append({
             "node_id": info.node_id.hex(),
             "alive": info.alive,
             "resources_total": dict(info.resources_total),
             "resources_available": dict(res.available) if res else {},
             "labels": dict(info.labels),
-            "is_head": info.node_id == w.node_group.head_node_id,
+            "is_head": is_head,
             "remote": info.node_id in w.node_group._remote_nodes,
             # latest heartbeat stats from the node's raylet (per-node
-            # agent plane); {} for the head (see /metrics for its view)
-            "stats": dict(stats[1]) if stats else {},
+            # agent plane), incl. per-worker RSS
+            "stats": stats_d,
         })
     return out
 
