@@ -46,6 +46,11 @@ def process_rss_bytes(pid: Optional[int] = None) -> int:
 # (_stack_evt/_stack_text) are shared state, and two overlapping
 # requesters would orphan each other's events.
 _REQUEST_LOCK = threading.Lock()
+# Makes slot RESET (requester) and slot DELIVERY (worker IO thread)
+# atomic against each other: a late reply from a previous timed-out
+# request must not interleave with the next request's reset (which
+# could report a responsive worker as unresponsive).
+_SLOT_LOCK = threading.Lock()
 
 
 def gather_pool_stacks(worker_pool, timeout: float = 3.0
@@ -72,8 +77,9 @@ def request_worker_stacks(workers, timeout: float = 3.0
     with _REQUEST_LOCK:
         asked = []
         for w in workers:
-            w._stack_evt = threading.Event()
-            w._stack_text = None
+            with _SLOT_LOCK:
+                w._stack_evt = threading.Event()
+                w._stack_text = None
             pid = getattr(getattr(w, "proc", None), "pid", None)
             try:
                 if pid is not None:
@@ -95,11 +101,15 @@ def request_worker_stacks(workers, timeout: float = 3.0
 
 def deliver_stack_reply(worker, text: str) -> None:
     """Reply half of ``request_worker_stacks`` (called from the reply
-    routers)."""
-    worker._stack_text = text
-    evt = getattr(worker, "_stack_evt", None)
-    if evt is not None:
-        evt.set()
+    routers). Atomic against slot reset — a straggler reply either
+    lands fully before the next request's reset (and is discarded by
+    it) or fully after (a fresh-enough dump the fresh reply then
+    overwrites)."""
+    with _SLOT_LOCK:
+        worker._stack_text = text
+        evt = getattr(worker, "_stack_evt", None)
+        if evt is not None:
+            evt.set()
 
 
 def worker_rss_map(worker_pool) -> Dict[str, int]:

@@ -66,6 +66,12 @@ class ServeController:
         self._proxies: List = []
         self._pushed_routes: Dict[str, tuple] = {}
         self._shutdown = threading.Event()
+        # Serializes reconcile passes: deploy() reconciles inline while
+        # the background loop also runs — unserialized, both see
+        # len(replicas) < target and double-create, and the surplus
+        # replica can eat the cluster's last CPU so the next creation
+        # parks in the scheduler forever.
+        self._reconcile_lock = threading.Lock()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="rtpu-serve-controller")
         self._thread.start()
@@ -188,14 +194,18 @@ class ServeController:
                 logger.exception("serve reconcile error")
 
     def _reconcile_once(self) -> None:
-        with self._lock:
-            infos = list(self._deployments.values())
-        for info in infos:
-            self._reconcile_deployment(info)
+        with self._reconcile_lock:
+            with self._lock:
+                infos = list(self._deployments.values())
+            for info in infos:
+                self._reconcile_deployment(info)
 
     def _reconcile_deployment(self, info: DeploymentInfo) -> None:
         if self._shutdown.is_set():
             return
+        with self._lock:
+            if self._deployments.get(info.name) is not info:
+                return   # superseded by a redeploy/delete mid-pass
         # 1. drop dead replicas (replica-death recovery)
         live = []
         for handle in info.replicas:
@@ -215,6 +225,14 @@ class ServeController:
             handle = self._create_replica(info)
             if handle is None:
                 break
+            with self._lock:
+                superseded = self._deployments.get(info.name) is not info
+            if superseded:
+                # a redeploy/delete swapped the table mid-create: this
+                # replica belongs to a dead generation — kill it now or
+                # it holds resources forever with no owner
+                self._kill_replicas([handle])
+                return
             info.replicas.append(handle)
         while len(info.replicas) > info.num_replicas:
             victim = info.replicas.pop()

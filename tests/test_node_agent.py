@@ -184,13 +184,21 @@ def test_stack_cli_against_remote_raylet(ray_start_cluster):
     from ray_tpu._private import rpc as _rpc
     from ray_tpu.scripts.cli import main as cli_main
     host, port = cluster.gcs_address
-    buf = io.StringIO()
-    with redirect_stdout(buf):
-        rc = cli_main(["stack", "--address", f"{host}:{port}",
-                       "--node", node_id.hex()[:12],
-                       "--token", _rpc.get_session_token() or ""])
-    out = buf.getvalue()
+
+    # retry: under full-suite load the raylet's GCS registration /
+    # worker spawn can lag the fixed sleep above
+    rc, out = 1, ""
+    deadline = time.monotonic() + 45
+    while time.monotonic() < deadline:
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cli_main(["stack", "--address", f"{host}:{port}",
+                           "--node", node_id.hex()[:12],
+                           "--token", _rpc.get_session_token() or ""])
+        out = buf.getvalue()
+        if rc == 0 and "raylet" in out and "thread" in out:
+            break
+        time.sleep(1.0)
     assert rc == 0, out
-    assert "raylet" in out
-    assert "thread" in out          # stack frames present
-    assert ray_tpu.get(ref, timeout=30) == "ok"
+    assert "raylet" in out and "thread" in out, out[:2000]
+    assert ray_tpu.get(ref, timeout=60) == "ok"
