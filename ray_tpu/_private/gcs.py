@@ -85,6 +85,14 @@ class GcsLite:
 
     def register_node(self, info: NodeInfo) -> None:
         with self._lock:
+            prev = self._nodes.get(info.node_id)
+            if prev is not None and prev.rpc_addr is not None \
+                    and info.rpc_addr is None:
+                # A raylet registered itself WITH its serving address;
+                # a later addr-less registration (e.g. the driver's
+                # bookkeeping one) must not clobber it — tooling
+                # (stack/log RPCs, health checks) dials that address.
+                info.rpc_addr = prev.rpc_addr
             self._nodes[info.node_id] = info
         self.publisher.publish("NODE", ("ADDED", info))
 

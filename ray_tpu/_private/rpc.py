@@ -72,6 +72,44 @@ def get_session_token() -> str:
     return os.environ.get(_TOKEN_ENV, "")
 
 
+_CURRENT_LINK = "/tmp/rtpu_current"
+
+
+def load_session_token_file(session: Optional[str] = None
+                            ) -> Optional[str]:
+    """Same-host tooling fallback: the 0600 token file
+    ``ensure_session_token`` persisted under the session dir. With no
+    session name, follow the ``rtpu_current`` pointer at the most
+    recent head session (the reference's ray_current_session analog).
+    None when absent/unreadable."""
+    if session is not None:
+        d = os.path.join("/tmp", f"rtpu_{session}")
+    else:
+        try:
+            if os.lstat(_CURRENT_LINK).st_uid != os.getuid():
+                return None
+            d = os.path.realpath(_CURRENT_LINK)
+        except OSError:
+            return None
+    path = os.path.join(d, "session_token")
+    try:
+        # O_NOFOLLOW + fstat on the OPENED fd: an lstat-then-open pair
+        # would be a TOCTOU (the /tmp session dir name is predictable,
+        # and a dir owner could swap in a symlink between the checks).
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_NOFOLLOW", 0))
+        try:
+            st = os.fstat(fd)
+            import stat as _stat
+            if st.st_uid != os.getuid() or not _stat.S_ISREG(st.st_mode):
+                return None
+            token = os.read(fd, 256).decode().strip()
+        finally:
+            os.close(fd)
+        return token or None
+    except OSError:
+        return None
+
+
 def ensure_session_token(session: str) -> str:
     """Mint the process's session token if absent and persist it 0600
     into the session dir for same-host tooling. The file is created
@@ -97,6 +135,13 @@ def ensure_session_token(session: str) -> str:
                      | getattr(os, "O_NOFOLLOW", 0))
     with os.fdopen(fd, "w") as f:
         f.write(token)
+    # point same-host tooling at the freshest session (atomic swap)
+    try:
+        tmp_link = f"{_CURRENT_LINK}.{os.getpid()}"
+        os.symlink(d, tmp_link)
+        os.replace(tmp_link, _CURRENT_LINK)
+    except OSError:
+        pass
     return token
 
 

@@ -128,6 +128,20 @@ class Worker:
         from ray_tpu._private import rpc as _rpc
         if self._join_address is None:
             _rpc.ensure_session_token(self.session)
+        elif not _rpc.get_session_token():
+            # same-host join with no token in the env: follow the
+            # rtpu_current pointer to the head's persisted token file
+            # (cross-host joiners still need RTPU_SESSION_TOKEN). Say
+            # so: the pointer tracks the FRESHEST head, so a handshake
+            # mismatch against an older session should read as "wrong
+            # auto-loaded token", not "broken cluster".
+            file_token = _rpc.load_session_token_file()
+            if file_token:
+                logger.info(
+                    "using same-host session token from the "
+                    "rtpu_current session dir (set RTPU_SESSION_TOKEN "
+                    "to join a different session)")
+                _rpc.set_session_token(file_token)
 
         # Exporter first: node/actor lifecycle events fire during the
         # rest of construction (head-node ADDED would otherwise vanish).

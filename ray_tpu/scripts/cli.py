@@ -23,6 +23,22 @@ import signal
 import sys
 
 
+
+def _install_token(args) -> None:
+    """--token wins; else env; else the same-host token file (via the
+    session name when given, else the rtpu_current pointer)."""
+    from ray_tpu._private import rpc as _rpc
+    if getattr(args, "token", ""):
+        _rpc.set_session_token(args.token)
+        return
+    if _rpc.get_session_token():
+        return
+    file_token = _rpc.load_session_token_file(
+        getattr(args, "session", None) or None)
+    if file_token:
+        _rpc.set_session_token(file_token)
+
+
 def _cmd_start(args) -> int:
     from ray_tpu._private import rpc as _rpc
     from ray_tpu._private.config import get_config
@@ -30,6 +46,13 @@ def _cmd_start(args) -> int:
     session = args.session
     if args.token:
         _rpc.set_session_token(args.token)
+    elif not args.head and not _rpc.get_session_token():
+        # same-host join with no --token and no env: pick up the token
+        # the head persisted into the session dir (that file exists for
+        # exactly this) — cross-host joiners still need --token
+        file_token = _rpc.load_session_token_file(session)
+        if file_token:
+            _rpc.set_session_token(file_token)
     if args.head:
         from ray_tpu._private.gcs_server import spawn_gcs_process
         token = _rpc.ensure_session_token(session)
@@ -68,9 +91,7 @@ def _cmd_start(args) -> int:
 
 def _cmd_status(args) -> int:
     from ray_tpu._private.gcs_client import GcsClient
-    if getattr(args, "token", ""):
-        from ray_tpu._private import rpc as _rpc
-        _rpc.set_session_token(args.token)
+    _install_token(args)
     host, port = args.address.rsplit(":", 1)
     client = GcsClient((host, int(port)))
     try:
@@ -207,9 +228,8 @@ def _follow_logs(args) -> int:
     import time as _time
 
     from ray_tpu._private.log_monitor import LogMonitor
-    if args.address and getattr(args, "token", ""):
-        from ray_tpu._private import rpc as _rpc
-        _rpc.set_session_token(args.token)
+    if args.address:
+        _install_token(args)
     # Eager first fetch: a bad address/token should ERROR at startup,
     # not produce a silent empty stream.
     initial = _remote_log_sources(args.address) if args.address else []
@@ -259,8 +279,7 @@ def _cmd_client_server(args) -> int:
 
     from ray_tpu._private import rpc as _rpc
     from ray_tpu._private.config import get_config
-    if args.token:
-        _rpc.set_session_token(args.token)
+    _install_token(args)
     d = os.path.join("/tmp", "rtpu_client_server")
     os.makedirs(d, exist_ok=True)
     port_file = os.path.join(d, f"cs_{os.getpid()}.addr")
@@ -310,9 +329,7 @@ def _cmd_stack(args) -> int:
     ``dump_stacks`` RPC."""
     from ray_tpu._private.gcs_client import GcsClient
     from ray_tpu._private.rpc import RpcClient
-    if getattr(args, "token", ""):
-        from ray_tpu._private import rpc as _rpc
-        _rpc.set_session_token(args.token)
+    _install_token(args)
     host, port = args.address.rsplit(":", 1)
     gcs = GcsClient((host, int(port)))
     try:

@@ -137,18 +137,23 @@ class ServeController:
         return info.replica_set
 
     def delete(self, name: str) -> None:
-        with self._lock:
-            info = self._deployments.pop(name, None)
-            self._pushed_routes.pop(name, None)
-            proxies = list(self._proxies)
-        if info is not None:
-            self._kill_replicas(info.replicas)
-            info.replica_set.set_replicas([])
-            for proxy in proxies:
-                try:
-                    proxy.update_routes.remote(name, None)
-                except Exception:
-                    pass
+        # Under the reconcile lock: an in-flight background pass would
+        # otherwise finish AFTER this delete and re-install routes to
+        # the replicas killed here — permanently, since the deployment
+        # is no longer in the table for a later pass to retract.
+        with self._reconcile_lock:
+            with self._lock:
+                info = self._deployments.pop(name, None)
+                self._pushed_routes.pop(name, None)
+                proxies = list(self._proxies)
+            if info is not None:
+                self._kill_replicas(info.replicas)
+                info.replica_set.set_replicas([])
+                for proxy in proxies:
+                    try:
+                        proxy.update_routes.remote(name, None)
+                    except Exception:
+                        pass
 
     def get_replica_set(self, name: str) -> Optional[ReplicaSet]:
         with self._lock:

@@ -191,10 +191,15 @@ def test_stack_cli_against_remote_raylet(ray_start_cluster):
     deadline = time.monotonic() + 45
     while time.monotonic() < deadline:
         buf = io.StringIO()
-        with redirect_stdout(buf):
-            rc = cli_main(["stack", "--address", f"{host}:{port}",
-                           "--node", node_id.hex()[:12],
-                           "--token", _rpc.get_session_token() or ""])
+        try:
+            with redirect_stdout(buf):
+                rc = cli_main(["stack", "--address", f"{host}:{port}",
+                               "--node", node_id.hex()[:12],
+                               "--token", _rpc.get_session_token() or ""])
+        except Exception as e:   # raylet RPC server not accepting yet
+            rc, out = 1, repr(e)
+            time.sleep(1.0)
+            continue
         out = buf.getvalue()
         if rc == 0 and "raylet" in out and "thread" in out:
             break
