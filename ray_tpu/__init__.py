@@ -27,6 +27,7 @@ __all__ = [
     "kill", "get_actor", "ObjectRef", "ActorClass", "ActorHandle",
     "RemoteFunction", "cluster_resources", "available_resources",
     "exceptions", "nodes", "timeline", "dump_stacks",
+    "get_runtime_context", "cancel",
 ]
 
 
@@ -103,6 +104,23 @@ def timeline() -> List[dict]:
     """Chrome-trace events for completed tasks (reference: ray timeline)."""
     from ray_tpu._private.events import get_task_events
     return get_task_events()
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    """Cancel the task that produces ``ref`` (best-effort, reference
+    ``ray.cancel``): queued tasks never run; running tasks receive
+    KeyboardInterrupt (``force=True`` kills the worker); cancelled
+    tasks never retry and their refs raise TaskCancelledError. A task
+    that already finished keeps its result. Actor calls raise
+    TypeError."""
+    return _worker_mod.global_worker().cancel_task(ref, force=force)
+
+
+def get_runtime_context():
+    """Identity of the calling context (driver/task/actor) — the
+    reference's ``ray.get_runtime_context()``."""
+    from ray_tpu.runtime_context import get_runtime_context as _grc
+    return _grc()
 
 
 def dump_stacks(node_id: Optional[str] = None) -> dict:

@@ -245,6 +245,12 @@ class NestedClient:
     def kill_actor(self, actor_id) -> None:
         self._client.call("nested_kill_actor", actor_id.binary())
 
+    def cancel_task(self, ref, force: bool = False) -> None:
+        """Proxy ray_tpu.cancel() to the owner (the driver runs the
+        actual queue removal / worker interruption)."""
+        self._client.call("nested_cancel", ref.id().binary(),
+                          bool(force))
+
     @property
     def gcs(self):
         client = self

@@ -896,6 +896,95 @@ class NodeManagerGroup:
                 payload["args"][i] = ("pull", oid_b, addr, size)
         return True
 
+    def cancel_queued(self, task_id: TaskID) -> bool:
+        """Remove a not-yet-running task from every queue it could sit
+        in (cluster queue, dep-wait, infeasible, per-raylet dispatch).
+        True if it was found and removed.
+
+        Accounting: only DISPATCH-queue specs hold anything — the
+        scheduler allocated node capacity (or drew from a PG bundle)
+        right before queueing them, so exactly those are freed here.
+        Specs still in _to_schedule/_waiting/_infeasible have drawn
+        nothing yet."""
+        spec = None
+        dispatch_node: Optional[NodeID] = None
+        with self._lock:
+            for q_spec in list(self._to_schedule):
+                if q_spec.task_id == task_id:
+                    self._to_schedule.remove(q_spec)
+                    spec = q_spec
+                    break
+            if spec is None:
+                spec = self._waiting.pop(task_id, None)
+                if spec is not None:
+                    self.dependency_manager.cancel_task(task_id)
+            if spec is None:
+                spec = self._infeasible.pop(task_id, None)
+            if spec is None:
+                for node_id, raylet in self._raylets.items():
+                    for q_spec in list(raylet.dispatch_queue):
+                        if q_spec.task_id == task_id:
+                            raylet.dispatch_queue.remove(q_spec)
+                            spec = q_spec
+                            dispatch_node = node_id
+                            break
+                    if spec is not None:
+                        break
+        if spec is None:
+            return False
+        if dispatch_node is not None:
+            # free what the scheduler reserved: the PG bundle draw when
+            # bound to one, else the node allocation
+            try:
+                self._free_allocation(dispatch_node,
+                                      dict(spec.resources),
+                                      self._spec_pg(spec))
+            except Exception:
+                logger.exception("cancel allocation free failed")
+        self._wake.set()
+        return True
+
+    def interrupt_running(self, task_id: TaskID, force: bool) -> bool:
+        """Best-effort interruption of a RUNNING task: SIGINT the
+        process worker (KeyboardInterrupt lands in the executing user
+        code; the worker survives), or kill it outright with
+        ``force``. In-process (thread) workers cannot be interrupted.
+        True if a signal/kill was delivered."""
+        import os as _os
+        import signal as _signal
+        with self._lock:
+            rt = self._running.get(task_id)
+        if rt is None:
+            return False
+        worker = rt.worker
+        if isinstance(worker, RemoteActorWorker):
+            return False
+        if isinstance(worker, _RemoteLease):
+            # forward to the remote raylet owning the execution
+            try:
+                worker.handle.client.oneway(
+                    "cancel_task", task_id.binary(), force)
+                return True
+            except Exception:
+                return False
+        pid = getattr(getattr(worker, "proc", None), "pid", None)
+        if pid is None:
+            return False            # in-process thread: uninterruptible
+        try:
+            if force:
+                worker.kill()       # death path completes the task
+            else:
+                # record the target FIRST: the worker's SIGINT handler
+                # drops signals aimed at a task it is no longer running
+                from ray_tpu._private.worker_process import (
+                    write_cancel_target)
+                write_cancel_target(self._session, pid,
+                                    task_id.binary())
+                _os.kill(pid, _signal.SIGINT)
+            return True
+        except Exception:
+            return False
+
     def release_actor(self, actor_id: ActorID, kill_worker: bool = True
                       ) -> None:
         with self._lock:

@@ -108,6 +108,7 @@ class RayletServer:
         self.server.register("submit", self._handle_submit)
         self.server.register("submit_batch", self._handle_submit_batch)
         self.server.register("kill_actor", self._handle_kill_actor)
+        self.server.register("cancel_task", self._handle_cancel_task)
         self.server.register("adjust_pool", self._handle_adjust_pool)
         self.server.register("shutdown", lambda ctx: self._request_shutdown())
 
@@ -184,6 +185,41 @@ class RayletServer:
             self._dispatch_queue.extend(payloads)
         self._wake.set()
         return "ok"
+
+    def _handle_cancel_task(self, ctx: ConnectionContext,
+                            task_id: bytes, force: bool = False) -> None:
+        """Owner-directed cancellation: dequeue if still pending here,
+        else SIGINT (or kill, with force) the executing worker. The
+        owner already marked the task cancelled, so whatever failure
+        this produces surfaces there as TaskCancelledError."""
+        import signal as _signal
+        with self._lock:
+            for payload in list(self._dispatch_queue):
+                if payload.get("task_id") == task_id:
+                    self._dispatch_queue.remove(payload)
+                    queued = True
+                    break
+            else:
+                queued = False
+            worker = self._running.get(task_id)
+        if queued:
+            self._push_owner("task_done", {
+                "task_id": task_id, "results": [], "error_blob": None,
+                "system_error": "cancelled by owner"})
+            return
+        if worker is None:
+            return
+        pid = getattr(getattr(worker, "proc", None), "pid", None)
+        try:
+            if force:
+                worker.kill()      # death path reports the failure
+            elif pid is not None:
+                from ray_tpu._private.worker_process import (
+                    write_cancel_target)
+                write_cancel_target(self.session, pid, task_id)
+                os.kill(pid, _signal.SIGINT)
+        except Exception:
+            pass
 
     def _handle_kill_actor(self, ctx: ConnectionContext,
                            actor_id: bytes) -> None:

@@ -72,7 +72,10 @@ def get_session_token() -> str:
     return os.environ.get(_TOKEN_ENV, "")
 
 
-_CURRENT_LINK = "/tmp/rtpu_current"
+# Per-uid: on a shared host, a second user's os.replace over another
+# user's symlink fails under /tmp's sticky bit — each user gets their
+# own pointer.
+_CURRENT_LINK = f"/tmp/rtpu_current_{os.getuid()}"
 
 
 def load_session_token_file(session: Optional[str] = None

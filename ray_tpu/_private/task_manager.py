@@ -34,6 +34,10 @@ class TaskRecord:
     # exactly once, even when lineage reconstruction re-runs a task
     # that already completed.
     args_released: bool = False
+    # ray_tpu.cancel(): a cancelled task's next failure is terminal
+    # (no retry) and surfaces as TaskCancelledError; a result that
+    # lands anyway wins (best-effort semantics, like the reference).
+    cancelled: bool = False
 
 
 def _contained_item(c):
@@ -121,6 +125,21 @@ class TaskManager:
             if rec:
                 rec.status = "running"
 
+    def mark_cancelled(self, task_id: TaskID) -> Optional[str]:
+        """Flag a task cancelled; returns its status at flag time
+        (None when unknown). Completion handling converts the task's
+        next failure into a terminal TaskCancelledError. A task that
+        already reached a terminal state is NOT flagged — cancel is a
+        documented no-op there, and the flag would otherwise poison a
+        later lineage-reconstruction re-run of the same record."""
+        with self._lock:
+            rec = self._tasks.get(task_id)
+            if rec is None:
+                return None
+            if rec.status not in ("finished", "failed"):
+                rec.cancelled = True
+            return rec.status
+
     def get_record(self, task_id: TaskID) -> Optional[TaskRecord]:
         with self._lock:
             return self._tasks.get(task_id)
@@ -151,6 +170,19 @@ class TaskManager:
                     self._store_result(ObjectID(oid_b), entry)
                 return
             # failure path
+            if rec.cancelled:
+                # cancelled: terminal, no retry, canonical error
+                from ray_tpu.exceptions import TaskCancelledError
+                rec.status = "failed"
+                self.num_failed += 1
+                self._release_args(rec)
+                blob = serialization.get_context().serialize(
+                    TaskCancelledError(
+                        f"task {rec.spec.repr_name()} was cancelled"
+                    )).to_bytes()
+                for oid in rec.spec.return_ids:
+                    self._store_result(oid, Entry("err", blob))
+                return
             retryable = system_error is not None
             if error_blob is not None and rec.spec.retry_exceptions:
                 retryable = self._error_matches(

@@ -675,6 +675,7 @@ class Worker:
         s.register("nested_create_actor", self._nested_create_actor)
         s.register("nested_actor_task", self._nested_actor_task)
         s.register("nested_kill_actor", self._nested_kill_actor)
+        s.register("nested_cancel", self._nested_cancel)
         s.register("nested_named_actor", self._nested_named_actor)
         s.register("nested_create_pg",
                    lambda ctx, b, bundles, strat, name:
@@ -747,6 +748,10 @@ class Worker:
 
     def _nested_kill_actor(self, ctx, actor_id_b: bytes) -> None:
         self.kill_actor(ActorID(actor_id_b))
+
+    def _nested_cancel(self, ctx, oid_b: bytes, force: bool) -> None:
+        self.cancel_task(ObjectRef(ObjectID(oid_b), _count=False),
+                         force=bool(force))
 
     def _nested_named_actor(self, ctx, name: str, namespace: str):
         return self.gcs.get_named_actor(name, namespace)
@@ -1640,6 +1645,37 @@ class Worker:
             from ray_tpu._private.object_store import (
                 sweep_orphan_segments)
             sweep_orphan_segments(self.session)
+
+    def cancel_task(self, ref, force: bool = False) -> None:
+        """Cancel a NORMAL task (reference ``ray.cancel`` semantics,
+        best-effort): a queued task never runs; a running task gets
+        KeyboardInterrupt (or its worker killed, with ``force``); a
+        finished task keeps its result. Consumers of a cancelled
+        task's refs see TaskCancelledError. Actor calls are not
+        cancellable (TypeError, like the reference)."""
+        from ray_tpu.exceptions import TaskCancelledError
+        task_id = ref.id().task_id()
+        rec = self.task_manager.get_record(task_id)
+        if rec is None:
+            return                       # unknown/already released
+        if rec.spec.task_type != TaskType.NORMAL_TASK:
+            raise TypeError(
+                "ray_tpu.cancel() supports normal tasks only; actor "
+                "calls cannot be cancelled")
+        status = self.task_manager.mark_cancelled(task_id)
+        if status in ("finished", "failed"):
+            return                       # too late: result/error stands
+        if self.node_group.cancel_queued(task_id):
+            # never ran: complete it as a terminal cancellation
+            self.task_manager.complete_task(
+                task_id, [], None,
+                TaskCancelledError(
+                    f"task {rec.spec.repr_name()} was cancelled before "
+                    "it started"))
+            return
+        # running (or in a dispatch race): interrupt best-effort; the
+        # resulting failure completes through the cancelled path
+        self.node_group.interrupt_running(task_id, force)
 
     def dump_stacks(self, node_id: Optional[NodeID] = None
                     ) -> Dict[str, Dict[str, str]]:

@@ -65,7 +65,8 @@ from ray_tpu.exceptions import TaskError
 # task and call the API from them; those threads inherit the process's
 # most-recent task identity (exact per-thread identity only matters for
 # blocked-parent resource release under max_concurrency>1).
-_TASK_FALLBACK: Dict[str, Any] = {"owner_addr": None, "task_id": b""}
+_TASK_FALLBACK: Dict[str, Any] = {"owner_addr": None, "task_id": b"",
+                                  "actor_id": b""}
 
 # Async-actor coroutines interleave on ONE loop thread, so their task
 # identity rides a contextvar (copied per asyncio task) instead of the
@@ -84,6 +85,7 @@ class _TaskLocal(threading.local):
 
     owner_addr = None
     task_id = b""
+    actor_id = b""
 
     def get(self, key, default=None):
         ctx = _CTX_TASK.get()
@@ -352,8 +354,10 @@ class ExecutionEnv:
         # by the user function (see _private/nested_client.py).
         _CURRENT_TASK.owner_addr = payload.get("owner_addr")
         _CURRENT_TASK.task_id = task_id
+        _CURRENT_TASK.actor_id = payload.get("actor_id") or b""
         _TASK_FALLBACK["owner_addr"] = payload.get("owner_addr")
         _TASK_FALLBACK["task_id"] = task_id
+        _TASK_FALLBACK["actor_id"] = payload.get("actor_id") or b""
         try:
             if payload.get("_missing_stage"):
                 raise RuntimeError(
@@ -462,7 +466,8 @@ class ExecutionEnv:
         # interleave on one thread, so a thread-local would leak one
         # call's identity into another across awaits.
         _CTX_TASK.set({"owner_addr": payload.get("owner_addr"),
-                       "task_id": task_id})
+                       "task_id": task_id,
+                       "actor_id": payload.get("actor_id") or b""})
         try:
             if payload.get("_missing_stage"):
                 raise RuntimeError(
@@ -610,6 +615,24 @@ class ExecutionEnv:
     def cache_function(self, function_id: bytes, blob: bytes) -> None:
         import cloudpickle
         self.functions[function_id] = cloudpickle.loads(blob)
+
+
+def cancel_target_path(session: str, pid: int) -> str:
+    return os.path.join("/tmp", f"rtpu_{session}", f"cancel_{pid}")
+
+
+def write_cancel_target(session: str, pid: int,
+                        task_id: bytes) -> None:
+    """Record WHICH task a cancellation SIGINT is aimed at before
+    signaling: the worker's handler compares it against the task it is
+    actually running, so a signal that raced the target's completion
+    cannot interrupt an innocent successor task."""
+    path = cancel_target_path(session, pid)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(task_id.hex())
+    os.replace(tmp, path)
 
 
 def _has_async_methods(instance) -> bool:
@@ -780,12 +803,44 @@ def worker_main(conn, session: str, max_inline_bytes: int,
     except (ValueError, OSError):
         pass    # non-main thread / exotic platform: pipe path only
 
+    # Targeted cancellation: SIGINT only interrupts the task it was
+    # aimed at (the sender writes the target's id first). A signal
+    # racing the target's completion finds a different current task and
+    # is dropped instead of failing an innocent successor.
+    _cancel_path = cancel_target_path(session, os.getpid())
+
+    def _on_sigint(signum, frame):
+        target = None
+        try:
+            with open(_cancel_path) as f:
+                target = f.read().strip()
+        except OSError:
+            pass
+        if target:
+            current = _TASK_FALLBACK.get("task_id") or b""
+            cur_hex = (current.hex() if isinstance(current, bytes)
+                       else str(current))
+            if target != cur_hex:
+                return          # aimed at a task that already finished
+        raise KeyboardInterrupt
+
+    try:
+        import signal as _signal
+        _signal.signal(_signal.SIGINT, _on_sigint)
+    except (ValueError, OSError):
+        pass
+
     try:
         while True:
             try:
                 msg = conn.recv()
             except (EOFError, OSError):
                 break
+            except KeyboardInterrupt:
+                # A cancellation SIGINT that raced the task's own
+                # completion lands here while idle: the cancel was for
+                # work that already finished — keep serving.
+                continue
             op = msg[0]
             if op == "shutdown":
                 break
@@ -797,7 +852,15 @@ def worker_main(conn, session: str, max_inline_bytes: int,
                 env.actor_templates[msg[1]] = msg[2]
             elif op in ("exec", "create_actor", "exec_actor",
                         "exec_actor_batch"):
-                env.dispatch(op, msg[1], send)
+                try:
+                    env.dispatch(op, msg[1], send)
+                finally:
+                    if op == "exec":
+                        # the cancellation-SIGINT guard compares
+                        # against this marker: once the task is done
+                        # (reply sent), a late signal must find NO
+                        # current task, not the finished one's id
+                        _TASK_FALLBACK["task_id"] = b""
             elif op == "core_addr":
                 # Compiled-DAG channel binding: report this process's
                 # owner-core address (creates the core on first ask).

@@ -1,0 +1,129 @@
+"""ray_tpu.cancel(): best-effort task cancellation.
+
+Reference analog: ``ray.cancel`` (``python/ray/_private/worker.py``
+cancel + core-worker CancelTask) [UNVERIFIED — mount empty,
+SURVEY.md §0]: queued tasks never run, running tasks get
+KeyboardInterrupt (force kills the worker), cancelled tasks never
+retry, finished tasks keep their results, actor calls refuse.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskCancelledError
+
+
+@pytest.fixture
+def rt():
+    w = ray_tpu.init(num_cpus=2, max_process_workers=2)
+    yield w
+    ray_tpu.shutdown()
+
+
+def test_cancel_queued_task_never_runs(rt, tmp_path):
+    mark = tmp_path / "ran"
+
+    @ray_tpu.remote(num_cpus=1)
+    def blocker():
+        time.sleep(5)
+        return "blocked"
+
+    @ray_tpu.remote(num_cpus=1)
+    def victim():
+        mark.touch()
+        return "ran"
+
+    # saturate both CPUs, then queue the victim behind them
+    b1, b2 = blocker.remote(), blocker.remote()
+    time.sleep(0.5)
+    v = victim.remote()
+    ray_tpu.cancel(v)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(v, timeout=30)
+    assert ray_tpu.get([b1, b2], timeout=60) == ["blocked", "blocked"]
+    time.sleep(0.3)
+    assert not mark.exists()        # the victim never executed
+
+
+def test_cancel_running_task_interrupts_worker_survives(rt):
+    @ray_tpu.remote
+    def napper():
+        time.sleep(30)
+        return "done"
+
+    ref = napper.remote()
+    time.sleep(1.0)                 # let it start
+    ray_tpu.cancel(ref)
+    t0 = time.perf_counter()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.perf_counter() - t0 < 20   # did not sleep out the 30s
+
+    # the interrupted worker keeps serving
+    @ray_tpu.remote
+    def quick():
+        return 7
+
+    assert ray_tpu.get(quick.remote(), timeout=30) == 7
+
+
+def test_cancel_force_kills_and_never_retries(rt):
+    @ray_tpu.remote(max_retries=3)
+    def stubborn():
+        # ignores KeyboardInterrupt: only force can stop it
+        while True:
+            try:
+                time.sleep(30)
+            except KeyboardInterrupt:
+                continue
+
+    ref = stubborn.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)   # no retry despite max_retries=3
+
+
+def test_cancel_after_finish_keeps_result(rt):
+    @ray_tpu.remote
+    def f():
+        return 42
+
+    ref = f.remote()
+    assert ray_tpu.get(ref, timeout=30) == 42
+    ray_tpu.cancel(ref)             # no-op: already finished
+    assert ray_tpu.get(ref, timeout=30) == 42
+
+
+def test_cancel_actor_call_refuses(rt):
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 1
+
+    a = A.remote()
+    ref = a.m.remote()
+    with pytest.raises(TypeError):
+        ray_tpu.cancel(ref)
+    assert ray_tpu.get(ref, timeout=30) == 1
+
+
+def test_cancel_on_remote_raylet(ray_start_cluster):
+    """Cancellation crosses to a remote raylet's worker."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"RC": 2}, remote=True)
+
+    @ray_tpu.remote(resources={"RC": 1})
+    def napper():
+        time.sleep(30)
+        return "done"
+
+    ref = napper.remote()
+    time.sleep(2.0)                 # running on the remote node
+    ray_tpu.cancel(ref)
+    t0 = time.perf_counter()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.perf_counter() - t0 < 25

@@ -1,0 +1,54 @@
+"""ray_tpu.get_runtime_context(): driver/task/actor identity.
+
+Reference analog: ``python/ray/runtime_context.py`` [UNVERIFIED —
+mount empty, SURVEY.md §0].
+"""
+
+import ray_tpu
+
+
+def test_driver_context(ray_start_regular):
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.is_driver
+    assert ctx.worker_mode == "driver"
+    assert ctx.get_task_id() is None
+    assert ctx.get_actor_id() is None
+    assert ctx.get_job_id()
+
+
+def test_task_context_matches_ref(ray_start_regular):
+    @ray_tpu.remote
+    def who():
+        c = ray_tpu.get_runtime_context()
+        return c.worker_mode, c.get_task_id(), c.get_actor_id()
+
+    ref = who.remote()
+    mode, task_id, actor_id = ray_tpu.get(ref)
+    assert mode == "worker"
+    assert task_id == ref.id().task_id().hex()
+    assert actor_id is None
+
+
+def test_actor_context(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def me(self):
+            c = ray_tpu.get_runtime_context()
+            return c.get_actor_id(), c.get_task_id()
+
+        async def me_async(self):
+            c = ray_tpu.get_runtime_context()
+            return c.get_actor_id()
+
+    a = A.remote()
+    actor_id, task_id = ray_tpu.get(a.me.remote())
+    assert actor_id == a._actor_id.hex()
+    assert task_id                      # actor call has a task id
+
+    @ray_tpu.remote
+    class B:
+        async def me(self):
+            return ray_tpu.get_runtime_context().get_actor_id()
+
+    b = B.remote()
+    assert ray_tpu.get(b.me.remote()) == b._actor_id.hex()
