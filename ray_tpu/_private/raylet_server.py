@@ -210,10 +210,13 @@ class RayletServer:
         if worker is None:
             return
         pid = getattr(getattr(worker, "proc", None), "pid", None)
+        if pid is None:
+            return      # in-process thread: uninterruptible (killing
+                        # the pool worker would not stop the task)
         try:
             if force:
                 worker.kill()      # death path reports the failure
-            elif pid is not None:
+            else:
                 from ray_tpu._private.worker_process import (
                     write_cancel_target)
                 write_cancel_target(self.session, pid, task_id)

@@ -163,7 +163,22 @@ class InProcessWorker(BaseWorker):
                 self.env.actor_templates[msg[1]] = msg[2]
             elif op in ("exec", "create_actor", "exec_actor",
                         "exec_actor_batch"):
-                self.env.dispatch(op, msg[1], send)
+                try:
+                    self.env.dispatch(op, msg[1], send)
+                finally:
+                    # The process-level identity fallback is shared
+                    # with the DRIVER (in-process workers live in its
+                    # process): any id left behind makes the driver
+                    # thread's get_runtime_context() misreport worker
+                    # mode. Clear after every synchronously executed
+                    # op — unlike process workers, untagged user
+                    # threads outliving an in-process task lose the
+                    # fallback identity, a cost worth the correct
+                    # driver context.
+                    from ray_tpu._private.worker_process import (
+                        _TASK_FALLBACK)
+                    _TASK_FALLBACK["task_id"] = b""
+                    _TASK_FALLBACK["actor_id"] = b""
 
     def send(self, msg: tuple) -> None:
         if msg[0] == "shutdown":

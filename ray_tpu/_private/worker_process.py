@@ -814,6 +814,9 @@ def worker_main(conn, session: str, max_inline_bytes: int,
         try:
             with open(_cancel_path) as f:
                 target = f.read().strip()
+            # one-shot marker: consume it, or a stale target would
+            # silently swallow every later non-cancel SIGINT
+            os.unlink(_cancel_path)
         except OSError:
             pass
         if target:

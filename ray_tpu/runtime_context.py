@@ -59,7 +59,10 @@ def get_runtime_context() -> RuntimeContext:
     from ray_tpu._private.worker import try_global_worker
     w = try_global_worker()
     # In-process (TPU-substrate) workers run in the driver process:
-    # their per-thread task identity takes precedence when set.
+    # their per-task identity (thread-local or, for async actors, the
+    # per-asyncio-task contextvar) takes precedence when set. The
+    # process-level fallback is cleared after each in-process normal
+    # task, so a finished one cannot misreport the driver thread.
     from ray_tpu._private.worker_process import _CURRENT_TASK
     task_id = _CURRENT_TASK.get("task_id") or None
     actor_id = _CURRENT_TASK.get("actor_id") or None

@@ -52,3 +52,29 @@ def test_actor_context(ray_start_regular):
 
     b = B.remote()
     assert ray_tpu.get(b.me.remote()) == b._actor_id.hex()
+
+
+def test_driver_context_after_inprocess_task(ray_start_regular):
+    """In-process (TPU-substrate) tasks run in the driver process; a
+    finished one must not make the driver thread report worker mode."""
+    @ray_tpu.remote(num_tpus=1)
+    def on_tpu_substrate():
+        return ray_tpu.get_runtime_context().worker_mode
+
+    assert ray_tpu.get(on_tpu_substrate.remote()) == "worker"
+    assert ray_tpu.get_runtime_context().is_driver
+
+
+def test_inprocess_async_actor_context(ray_start_regular):
+    """Async actors on the in-process (TPU) substrate report identity
+    through the per-asyncio-task contextvar."""
+    @ray_tpu.remote(num_tpus=1)
+    class A:
+        async def me(self):
+            c = ray_tpu.get_runtime_context()
+            return c.worker_mode, c.get_actor_id()
+
+    a = A.remote()
+    mode, aid = ray_tpu.get(a.me.remote())
+    assert mode == "worker" and aid == a._actor_id.hex()
+    assert ray_tpu.get_runtime_context().is_driver
