@@ -192,17 +192,20 @@ class Application:
 class Deployment:
     def __init__(self, target: Union[type, Callable], name: str,
                  num_replicas: int, ray_actor_options: Optional[dict],
-                 autoscaling_config: Optional[dict]):
+                 autoscaling_config: Optional[dict],
+                 max_ongoing_requests: Optional[int] = None):
         self._target = target
         self.name = name
         self.num_replicas = num_replicas
         self.ray_actor_options = dict(ray_actor_options or {})
         self.autoscaling_config = autoscaling_config
+        self.max_ongoing_requests = max_ongoing_requests
 
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[int] = None,
                 ray_actor_options: Optional[dict] = None,
-                autoscaling_config: Optional[dict] = None) -> "Deployment":
+                autoscaling_config: Optional[dict] = None,
+                max_ongoing_requests: Optional[int] = None) -> "Deployment":
         return Deployment(
             self._target,
             name if name is not None else self.name,
@@ -210,7 +213,9 @@ class Deployment:
             ray_actor_options if ray_actor_options is not None
             else self.ray_actor_options,
             autoscaling_config if autoscaling_config is not None
-            else self.autoscaling_config)
+            else self.autoscaling_config,
+            max_ongoing_requests if max_ongoing_requests is not None
+            else self.max_ongoing_requests)
 
     def bind(self, *args, **kwargs) -> Application:
         return Application(self, args, kwargs)
@@ -219,12 +224,17 @@ class Deployment:
 def deployment(_target=None, *, name: Optional[str] = None,
                num_replicas: int = 1,
                ray_actor_options: Optional[dict] = None,
-               autoscaling_config: Optional[dict] = None):
-    """``@serve.deployment`` decorator for classes and functions."""
+               autoscaling_config: Optional[dict] = None,
+               max_ongoing_requests: Optional[int] = None):
+    """``@serve.deployment`` decorator for classes and functions.
+    ``max_ongoing_requests`` caps each replica's in-flight requests
+    (admission control): excess callers wait in the router instead of
+    piling onto replicas."""
 
     def wrap(target):
         return Deployment(target, name or target.__name__, num_replicas,
-                          ray_actor_options, autoscaling_config)
+                          ray_actor_options, autoscaling_config,
+                          max_ongoing_requests)
 
     if _target is not None:
         return wrap(_target)
@@ -248,7 +258,8 @@ def run(app: Union[Application, Deployment], *, name: Optional[str] = None,
     replica_set = controller.deploy(
         dep_name, dep._target, app.init_args, app.init_kwargs,
         dep.num_replicas, actor_options=dep.ray_actor_options,
-        autoscaling=autoscaling)
+        autoscaling=autoscaling,
+        max_ongoing_requests=dep.max_ongoing_requests)
     if wait_for_healthy:
         controller.wait_healthy(dep_name, timeout=timeout)
     return DeploymentHandle(dep_name, replica_set)

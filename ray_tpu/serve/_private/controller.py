@@ -113,7 +113,8 @@ class ServeController:
     def deploy(self, name: str, target, init_args: tuple,
                init_kwargs: dict, num_replicas: int,
                actor_options: Optional[dict] = None,
-               autoscaling: Optional[AutoscalingConfig] = None
+               autoscaling: Optional[AutoscalingConfig] = None,
+               max_ongoing_requests: Optional[int] = None
                ) -> ReplicaSet:
         info = DeploymentInfo(
             name=name,
@@ -133,6 +134,10 @@ class ServeController:
                 info.replica_set = old.replica_set   # handles stay valid
                 self._kill_replicas(old.replicas)
             self._deployments[name] = info
+            # inside the lock and after the old-set swap: a concurrent
+            # redeploy must not leave the superseded deploy's cap on
+            # the shared replica set
+            info.replica_set.max_ongoing = max_ongoing_requests
         self._reconcile_once()
         return info.replica_set
 
@@ -300,7 +305,8 @@ class ServeController:
             opts = dict(info.actor_options)
             opts.setdefault("max_restarts", 0)
             handle = actor_cls.options(**opts).remote(
-                info.deployment_blob, info.init_args, info.init_kwargs)
+                info.deployment_blob, info.init_args, info.init_kwargs,
+                info.replica_set.max_ongoing)
             # wait for construction so state flips once it's servable
             ray_tpu.get(handle.ping.remote(), timeout=120)
             return handle

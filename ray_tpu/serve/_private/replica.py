@@ -28,7 +28,7 @@ class ReplicaActor:
     """Wraps the user's deployment class/function."""
 
     def __init__(self, deployment_blob: bytes, init_args: tuple,
-                 init_kwargs: dict):
+                 init_kwargs: dict, max_ongoing_requests=None):
         import cloudpickle
         target = cloudpickle.loads(deployment_blob)
         if isinstance(target, type):
@@ -37,6 +37,18 @@ class ReplicaActor:
             if init_args or init_kwargs:
                 raise TypeError("function deployments take no init args")
             self._callable = target
+        # Replica-side admission (the HARD max_ongoing_requests cap):
+        # router copies in proxies/composed handles count in-flight
+        # locally, so only this semaphore bounds the true concurrency.
+        # Created lazily on the replica's event loop.
+        self._max_ongoing = max_ongoing_requests
+        self._admission = None
+
+    def _admission_sem(self):
+        if self._admission is None and self._max_ongoing:
+            import asyncio
+            self._admission = asyncio.Semaphore(int(self._max_ongoing))
+        return self._admission
 
     def _resolve(self, method: str):
         if method in ("__call__", ""):
@@ -45,6 +57,14 @@ class ReplicaActor:
 
     async def handle_request(self, method: str, args: tuple, kwargs: dict,
                              model_id=None):
+        sem = self._admission_sem()
+        if sem is not None:
+            async with sem:
+                return await self._invoke(method, args, kwargs, model_id)
+        return await self._invoke(method, args, kwargs, model_id)
+
+    async def _invoke(self, method: str, args: tuple, kwargs: dict,
+                      model_id):
         fn = self._resolve(method)
         token = (_multiplex_ctx.set(model_id)
                  if model_id is not None else None)
@@ -63,7 +83,21 @@ class ReplicaActor:
         the proxy's streaming path): the user method may return a sync
         generator, an async generator, or a plain value (streamed as a
         single item). Items flow to the caller AS they are yielded —
-        consumers read them before the producer finishes."""
+        consumers read them before the producer finishes. A streaming
+        request holds its admission slot for the whole generation."""
+        sem = self._admission_sem()
+        if sem is not None:
+            async with sem:
+                async for item in self._invoke_streaming(
+                        method, args, kwargs, model_id):
+                    yield item
+            return
+        async for item in self._invoke_streaming(method, args, kwargs,
+                                                 model_id):
+            yield item
+
+    async def _invoke_streaming(self, method: str, args: tuple,
+                                kwargs: dict, model_id=None):
         fn = self._resolve(method)
         token = (_multiplex_ctx.set(model_id)
                  if model_id is not None else None)

@@ -12,14 +12,24 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import Dict, List, Optional
 
 from ray_tpu._private.object_ref import ObjectRef
 
 
-def _rebuild_replica_set(name: str, replicas: List) -> "ReplicaSet":
+def _rebuild_replica_set(name: str, replicas: List,
+                         max_ongoing=None) -> "ReplicaSet":
     rs = ReplicaSet(name)
     rs.set_replicas(replicas)
+    rs.max_ongoing = max_ongoing
+    # Pickled copies (proxy actors, composed handles inside replicas)
+    # NEVER block in the router: their in-flight counts are local, so
+    # the cap they could enforce is approximate anyway — and a blocking
+    # wait inside an async replica would stall its whole event loop.
+    # The HARD per-replica cap is the replica-side admission semaphore;
+    # copies lean on it and only load-balance here.
+    rs._router_wait = False
     return rs
 
 
@@ -35,13 +45,26 @@ class ReplicaSet:
     snapshot; replaced replicas surface as actor-dead errors on call.
     """
 
+    # how long begin() waits for a replica slot under a
+    # max_ongoing_requests cap before giving up (backpressure bound)
+    ADMISSION_TIMEOUT_S = 120.0
+
     def __reduce__(self):
         return (_rebuild_replica_set,
-                (self.deployment_name, self.replicas()))
+                (self.deployment_name, self.replicas(),
+                 self.max_ongoing))
 
     def __init__(self, deployment_name: str):
         self.deployment_name = deployment_name
         self._lock = threading.Lock()
+        self._slot_free = threading.Condition(self._lock)
+        # per-replica in-flight cap (None = uncapped): the reference's
+        # max_ongoing_requests admission control — requests beyond
+        # cap × replicas WAIT here instead of piling onto replicas
+        self.max_ongoing: Optional[int] = None
+        # the driver's original set gates admission in begin(); pickled
+        # copies rely on the replica-side semaphore (see _rebuild)
+        self._router_wait = True
         self._replicas: List = []          # ActorHandle list
         self._inflight: Dict[int, int] = {}  # id(handle) -> count
         # model multiplexing: sticky model_id -> replica key, so a
@@ -65,6 +88,7 @@ class ReplicaSet:
             self._model_routes = {m: k
                                   for m, k in self._model_routes.items()
                                   if k in keep}
+            self._slot_free.notify_all()   # membership may free slots
 
     def replicas(self) -> List:
         with self._lock:
@@ -85,43 +109,74 @@ class ReplicaSet:
         in-flight request to it. Returns the replica handle; the caller
         MUST balance with ``end(id(handle))`` when the request
         resolves (``assign`` wires this automatically)."""
+        deadline = None
         with self._lock:
-            if not self._replicas:
-                raise RuntimeError(
-                    f"deployment {self.deployment_name!r} has no live "
-                    "replicas")
-            chosen = None
-            if model_id is not None:
-                key = self._model_routes.get(model_id)
-                if key is not None:
-                    chosen = next((r for r in self._replicas
-                                   if id(r) == key), None)
-                if chosen is None:
+            while True:
+                if not self._replicas:
+                    raise RuntimeError(
+                        f"deployment {self.deployment_name!r} has no "
+                        "live replicas")
+                cap = (self.max_ongoing if self._router_wait else None)
+                pool = (self._replicas if cap is None else
+                        [r for r in self._replicas
+                         if self._inflight.get(id(r), 0) < cap])
+                pinned_full = False
+                chosen = None
+                if model_id is not None:
+                    key = self._model_routes.get(model_id)
+                    if key is not None:
+                        chosen = next((r for r in self._replicas
+                                       if id(r) == key), None)
+                        if chosen is not None and chosen not in pool:
+                            # pinned replica alive but at cap: WAIT for
+                            # its slot — re-pinning would bounce the
+                            # model's hot weights between replicas
+                            pinned_full = True
+                            chosen = None
+                if not pool or pinned_full:
+                    # every candidate at its cap: wait for a release
+                    if deadline is None:
+                        deadline = (time.monotonic()
+                                    + self.ADMISSION_TIMEOUT_S)
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._slot_free.wait(
+                            timeout=remaining):
+                        if time.monotonic() >= deadline:
+                            raise RuntimeError(
+                                f"deployment "
+                                f"{self.deployment_name!r}: all "
+                                f"replicas at max_ongoing_requests="
+                                f"{cap} for "
+                                f"{self.ADMISSION_TIMEOUT_S:.0f}s")
+                    continue
+                if model_id is not None and chosen is None:
                     # first sight of this model (or its replica died):
-                    # pin it to the least-loaded replica
-                    chosen = min(self._replicas,
+                    # pin to the least-loaded replica
+                    chosen = min(pool,
                                  key=lambda r: self._inflight.get(
                                      id(r), 0))
                     self._model_routes[model_id] = id(chosen)
-            elif len(self._replicas) == 1:
-                chosen = self._replicas[0]
-            else:
-                # power of two choices on tracked queue length
-                a, b = self._rng.sample(self._replicas, 2)
-                chosen = (a if self._inflight.get(id(a), 0)
-                          <= self._inflight.get(id(b), 0) else b)
-            self._inflight[id(chosen)] = \
-                self._inflight.get(id(chosen), 0) + 1
-            self.total_assigned += 1
-        return chosen
+                if chosen is None:
+                    if len(pool) == 1:
+                        chosen = pool[0]
+                    else:
+                        # power of two choices on tracked queue length
+                        a, b = self._rng.sample(pool, 2)
+                        chosen = (a if self._inflight.get(id(a), 0)
+                                  <= self._inflight.get(id(b), 0) else b)
+                self._inflight[id(chosen)] = \
+                    self._inflight.get(id(chosen), 0) + 1
+                self.total_assigned += 1
+                return chosen
 
     def end(self, replica_key: int) -> None:
         """Release one in-flight charge (ongoing-requests signal for
-        pow-2 and autoscaling)."""
+        pow-2, autoscaling, and admission waits)."""
         with self._lock:
             if replica_key in self._inflight:
                 self._inflight[replica_key] = max(
                     0, self._inflight[replica_key] - 1)
+            self._slot_free.notify_all()
 
     def assign(self, method: str, args: tuple, kwargs: dict,
                model_id: Optional[str] = None, stream: bool = False):

@@ -411,3 +411,45 @@ def test_worker_hosted_proxy(serve_instance):
             time.sleep(0.2)
     else:
         pytest.fail("worker proxy never learned the streaming route")
+
+
+def test_max_ongoing_requests_caps_replica_concurrency(serve_instance):
+    """Admission control: per-replica in-flight never exceeds the cap;
+    excess callers wait in the router and proceed as slots free."""
+    import threading
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=2)
+    class Gauge:
+        def __init__(self):
+            self.inflight = 0
+            self.peak = 0
+
+        async def __call__(self, _x=None):
+            import asyncio
+            self.inflight += 1
+            self.peak = max(self.peak, self.inflight)
+            await asyncio.sleep(0.25)
+            self.inflight -= 1
+            return self.peak
+
+        async def peak_seen(self):
+            return self.peak
+
+    handle = serve.run(Gauge.bind())
+    refs = []
+    lock = threading.Lock()
+
+    def fire():
+        r = handle.remote()          # may block in admission
+        with lock:
+            refs.append(r)
+
+    threads = [threading.Thread(target=fire) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(refs) == 8, len(refs)   # no caller was rejected
+    ray_tpu.get(refs, timeout=60)
+    peak = ray_tpu.get(handle.peak_seen.remote(), timeout=30)
+    assert 1 <= peak <= 2, peak      # the cap held under 8 callers
