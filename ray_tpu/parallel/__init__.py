@@ -1,0 +1,7 @@
+"""Parallelism substrate: meshes, multi-host, multi-slice, pipeline."""
+
+from ray_tpu.parallel.mesh import (  # noqa: F401
+    AXES, MeshSpec, make_mesh, local_mesh, shard, sharding_for,
+    tree_shardings)
+from ray_tpu.parallel.slice_mesh import (  # noqa: F401
+    SliceMesh, SliceTopology, make_slice_mesh, slice_index)

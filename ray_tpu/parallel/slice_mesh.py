@@ -1,0 +1,329 @@
+"""Multi-slice meshes: ICI within a slice, DCN across slices.
+
+Reference: upstream royf/ray scales past one accelerator island with a
+second collective tier — NCCL rings within a node/slice and a
+host-network (Gloo/TCP or NCCL-over-IB) plane between them
+(``python/ray/util/collective/`` group-spanning semantics; SURVEY.md §5
+comm-backend row, §2.5 collective row [UNVERIFIED — mount empty,
+SURVEY.md §0]). The TPU-native shape of that tier is not a second
+backend: a TPU *slice* is the ICI-connected island, slices are joined
+by the data-center network (DCN), and XLA already emits the right
+transport for a collective from the DEVICE GRID GEOMETRY alone —
+collectives along a mesh axis whose strides stay inside one slice ride
+ICI; an axis whose strides cross slice boundaries rides DCN.
+
+So the whole multi-slice plane reduces to one constructor invariant:
+
+    **exactly one logical axis spans slices; every other axis's device
+    groups stay inside a single slice.**
+
+``SliceTopology`` names that axis (``cross``, usually ``dp``) and the
+per-slice layout (``inner``); ``make_slice_mesh`` builds the global
+``jax.sharding.Mesh`` honoring the invariant, so the usual sharding
+vocabulary — "fsdp within slice, dp across slices" — is literally
+``SliceTopology(num_slices=S, inner=MeshSpec(fsdp=D), cross="dp")``
+and every existing pjit/shard_map program runs unchanged over it.
+
+Slice membership is discovered, in order:
+  1. ``device.slice_index`` — real multi-slice TPU (megascale) runtime;
+  2. ``device.process_index`` — simulated slices: each
+     ``jax.distributed`` process (or a contiguous block of processes)
+     is one slice, the exact topology ``tests/multihost_member.py``
+     fakes a pod with;
+  3. contiguous partition of the device list — single-process virtual
+     platforms (``--xla_force_host_platform_device_count``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+# Axis-name order of MeshSpec.axis_sizes(), i.e. mesh dimension order.
+_MESH_AXES = tuple(MeshSpec().axis_sizes())
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """S slices, each laid out as ``inner``; ``cross`` rides DCN.
+
+    ``inner`` must leave the ``cross`` axis at 1 — the global spec is
+    ``inner`` with ``cross`` set to ``num_slices``.
+    """
+
+    num_slices: int
+    inner: MeshSpec
+    cross: str = "dp"
+
+    def __post_init__(self):
+        if self.num_slices < 1:
+            raise ValueError(f"num_slices must be >= 1, got {self.num_slices}")
+        if self.cross not in _MESH_AXES:
+            raise ValueError(
+                f"cross axis {self.cross!r} not one of {_MESH_AXES}")
+        if getattr(self.inner, self.cross) != 1:
+            raise ValueError(
+                f"inner spec must leave the cross axis {self.cross!r} at 1 "
+                f"(got {getattr(self.inner, self.cross)}); the global extent "
+                f"of {self.cross!r} is num_slices")
+
+    @property
+    def devices_per_slice(self) -> int:
+        return self.inner.num_devices
+
+    @property
+    def global_spec(self) -> MeshSpec:
+        return dataclasses.replace(self.inner, **{self.cross: self.num_slices})
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return self.global_spec.axis_sizes()
+
+
+def _slice_id_of(dev) -> Optional[int]:
+    """Real-hardware slice id if the runtime exposes one."""
+    sid = getattr(dev, "slice_index", None)
+    if isinstance(sid, int) and sid >= 0:
+        return sid
+    return None
+
+
+def group_devices_by_slice(devices: Sequence, num_slices: int,
+                           per: Optional[int] = None,
+                           allow_split_slices: bool = False
+                           ) -> List[List]:
+    """Partition ``devices`` into ``num_slices`` groups of ``per``
+    devices each, honoring physical boundaries.
+
+    Grouping keys, in priority order: ``slice_index`` (real multi-slice
+    TPU — surplus slices/devices beyond the topology are dropped from
+    the END, never mixed), ``process_index`` (simulated slices — one
+    process, a contiguous block of processes, or a fraction of one
+    process per slice, never straddling), positional blocks
+    (single-process virtual platform). Physical boundaries are
+    discovered on the FULL list before any surplus is dropped. A
+    grouping that would put one slice's devices on both sides of a
+    physical boundary — the module invariant — raises instead of
+    silently degrading; ``allow_split_slices=True`` opts out of the
+    hardware tier for deliberate simulation of multiple slices on
+    single-slice hardware.
+    """
+    devs = list(devices)
+    if per is None:
+        if len(devs) % num_slices != 0:
+            raise ValueError(
+                f"{len(devs)} devices not divisible into "
+                f"{num_slices} slices")
+        per = len(devs) // num_slices
+
+    # Tier 1: hardware slice ids (including the all-one-slice case —
+    # splitting a real slice in two would put the "DCN" axis on ICI,
+    # so that asks for an explicit allow_split_slices). Virtual CPU
+    # platforms also stamp slice_index (always 0) but there is no
+    # hardware there to misrepresent — simulation IS the point — so
+    # the strict tier only applies to real accelerators.
+    sids = [_slice_id_of(d) for d in devs]
+    all_cpu = all(getattr(d, "platform", "") == "cpu" for d in devs)
+    if all(s is not None for s in sids) and not all_cpu \
+            and not allow_split_slices:
+        groups: Dict[int, List] = {}
+        for d, s in zip(devs, sids):
+            groups.setdefault(s, []).append(d)
+        if len(groups) < num_slices:
+            raise ValueError(
+                f"hardware reports {len(groups)} slice(s) "
+                f"({sorted(groups)}), topology wants {num_slices}; "
+                f"pass allow_split_slices=True to simulate more "
+                f"slices than the hardware has")
+        out = []
+        for s in sorted(groups)[:num_slices]:
+            if len(groups[s]) < per:
+                raise ValueError(
+                    f"hardware slice {s} has {len(groups[s])} devices, "
+                    f"topology needs {per} per slice")
+            out.append(groups[s][:per])
+        return out
+
+    # Tier 2: process boundaries, discovered on the full list.
+    pids = sorted({getattr(d, "process_index", 0) for d in devs})
+    if len(pids) > 1:
+        by_pid: Dict[int, List] = {p: [] for p in pids}
+        for d in devs:
+            by_pid[getattr(d, "process_index", 0)].append(d)
+        if len(pids) % num_slices == 0:
+            # A contiguous block of processes per slice.
+            procs_per_slice = len(pids) // num_slices
+            out = []
+            for s in range(num_slices):
+                block: List = []
+                for p in pids[s * procs_per_slice:
+                              (s + 1) * procs_per_slice]:
+                    block.extend(by_pid[p])
+                if len(block) < per:
+                    raise ValueError(
+                        f"slice {s} (processes "
+                        f"{pids[s * procs_per_slice:(s + 1) * procs_per_slice]}) "
+                        f"has {len(block)} devices, topology needs {per}")
+                out.append(block[:per])
+            return out
+        if num_slices % len(pids) == 0:
+            # Several simulated slices inside each process — sound
+            # because no block straddles a process.
+            slices_per_proc = num_slices // len(pids)
+            out = []
+            for p in pids:
+                if len(by_pid[p]) < slices_per_proc * per:
+                    raise ValueError(
+                        f"process {p} has {len(by_pid[p])} devices, "
+                        f"needs {slices_per_proc * per} for "
+                        f"{slices_per_proc} slices")
+                for s in range(slices_per_proc):
+                    out.append(by_pid[p][s * per:(s + 1) * per])
+            return out
+        raise ValueError(
+            f"cannot partition devices across {len(pids)} processes "
+            f"into {num_slices} slices without a slice straddling a "
+            f"process boundary; use a slice count that divides (or is "
+            f"a multiple of) the process count")
+
+    # Tier 3: positional blocks (single process, no hardware ids).
+    devs = devs[:num_slices * per]
+    if len(devs) < num_slices * per:
+        raise ValueError(
+            f"need {num_slices * per} devices, have {len(devs)}")
+    return [devs[i * per:(i + 1) * per] for i in range(num_slices)]
+
+
+class SliceMesh:
+    """A global ``Mesh`` plus its slice decomposition.
+
+    Usable anywhere a ``jax.sharding.Mesh`` is (context manager,
+    ``.mesh`` for explicit passing); additionally knows which axis is
+    the DCN plane and can hand back each slice's ICI submesh.
+    """
+
+    def __init__(self, topology: SliceTopology, mesh,
+                 slice_groups: List[List]):
+        self.topology = topology
+        self.mesh = mesh
+        self._slice_groups = slice_groups
+
+    # -- Mesh-compatible surface ------------------------------------
+    def __enter__(self):
+        return self.mesh.__enter__()
+
+    def __exit__(self, *exc):
+        return self.mesh.__exit__(*exc)
+
+    @property
+    def axis_names(self):
+        return self.mesh.axis_names
+
+    @property
+    def devices(self):
+        return self.mesh.devices
+
+    @property
+    def shape(self):
+        return self.mesh.shape
+
+    # -- slice plane -------------------------------------------------
+    @property
+    def num_slices(self) -> int:
+        return self.topology.num_slices
+
+    @property
+    def dcn_axis(self) -> str:
+        """The logical axis whose collectives cross slices (ride DCN)."""
+        return self.topology.cross
+
+    @property
+    def ici_axes(self) -> tuple:
+        return tuple(a for a in _MESH_AXES if a != self.topology.cross)
+
+    def slice_devices(self, i: int) -> List:
+        return list(self._slice_groups[i])
+
+    def local_slice_index(self) -> int:
+        """The slice this process's devices landed in — correct under
+        every grouping tier (hardware ids, process-as-slice simulation,
+        positional). Raises if local devices span slices (a process
+        hosting several simulated slices has no single index)."""
+        import jax
+        local = set(jax.local_devices())
+        hits = {i for i, g in enumerate(self._slice_groups)
+                if local & set(g)}
+        if len(hits) != 1:
+            raise ValueError(
+                f"this process's devices belong to slices "
+                f"{sorted(hits)}; per-slice gating needs exactly one")
+        return next(iter(hits))
+
+    def slice_submesh(self, i: int):
+        """Slice i's devices as a standalone ICI mesh (``inner`` spec) —
+        for per-slice work (slice-local eval, per-slice data loading)."""
+        from ray_tpu.parallel.mesh import make_mesh
+        return make_mesh(self.topology.inner, self._slice_groups[i])
+
+    def describe(self) -> Dict[str, object]:
+        return {
+            "slices": self.num_slices,
+            "devices_per_slice": self.topology.devices_per_slice,
+            "dcn_axis": self.dcn_axis,
+            "global": dict(self.topology.axis_sizes()),
+        }
+
+
+def make_slice_mesh(topology: SliceTopology,
+                    devices: Optional[Sequence] = None,
+                    allow_split_slices: bool = False) -> SliceMesh:
+    """Build the global mesh with the cross-slice axis aligned to slice
+    boundaries.
+
+    The device grid is assembled per-slice — each slice's devices
+    reshaped to the ``inner`` grid — then stacked along the ``cross``
+    dimension, so indexing along ``cross`` walks across slices and
+    every other axis stays inside one slice. XLA sees that geometry
+    and schedules ``cross``-axis collectives on the cross-slice
+    (DCN) transport, everything else on ICI.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices if devices is not None else jax.devices())
+    need = topology.num_slices * topology.devices_per_slice
+    if len(devs) < need:
+        raise ValueError(
+            f"topology needs {need} devices "
+            f"({topology.num_slices} slices x "
+            f"{topology.devices_per_slice}), have {len(devs)}")
+    # The full list goes to the grouper: hardware slice boundaries must
+    # be discovered before any surplus devices are dropped.
+    groups = group_devices_by_slice(devs, topology.num_slices,
+                                    per=topology.devices_per_slice,
+                                    allow_split_slices=allow_split_slices)
+
+    inner_shape = tuple(topology.inner.axis_sizes().values())
+    per_slice = [np.asarray(g, dtype=object).reshape(inner_shape)
+                 for g in groups]
+    cross_dim = _MESH_AXES.index(topology.cross)
+    grid = np.concatenate(per_slice, axis=cross_dim)
+    mesh = Mesh(grid, axis_names=_MESH_AXES)
+    return SliceMesh(topology, mesh, groups)
+
+
+def slice_index() -> int:
+    """This process's HARDWARE slice id (first local device), else 0.
+    Under simulated (process-as-slice) topologies devices carry no
+    slice id, so this returns 0 everywhere — use
+    ``SliceMesh.local_slice_index()``, which knows the topology's
+    actual grouping, for per-slice gating."""
+    import jax
+    local = jax.local_devices()
+    if not local:
+        return 0
+    sid = _slice_id_of(local[0])
+    return sid if sid is not None else 0

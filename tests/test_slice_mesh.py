@@ -1,0 +1,186 @@
+"""Multi-slice mesh tests: the cross-slice axis aligned to slice
+boundaries (DCN plane), inner axes within a slice (ICI), same
+NamedSharding vocabulary throughout (SURVEY.md §2.5 collective row,
+§5 comm-backend row)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+from ray_tpu.parallel.multihost import spawn_local_group
+from ray_tpu.parallel.slice_mesh import (
+    SliceTopology, group_devices_by_slice, make_slice_mesh)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_topology_validation():
+    with pytest.raises(ValueError, match="cross axis"):
+        SliceTopology(num_slices=2, inner=MeshSpec(fsdp=4), cross="qp")
+    with pytest.raises(ValueError, match="leave the cross axis"):
+        SliceTopology(num_slices=2, inner=MeshSpec(dp=2, fsdp=2), cross="dp")
+    with pytest.raises(ValueError, match="num_slices"):
+        SliceTopology(num_slices=0, inner=MeshSpec(fsdp=4))
+
+
+def test_grouping_positional_single_process():
+    devs = jax.devices()[:8]
+    groups = group_devices_by_slice(devs, 2)
+    assert [len(g) for g in groups] == [4, 4]
+    assert groups[0] == devs[:4] and groups[1] == devs[4:]
+    with pytest.raises(ValueError, match="not divisible"):
+        group_devices_by_slice(devs[:6], 4)
+
+
+class _FakeDev:
+    def __init__(self, i, process_index=0, slice_index=None):
+        self.id = i
+        self.process_index = process_index
+        if slice_index is not None:
+            self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"d{self.id}"
+
+
+def test_grouping_hardware_slice_ids_with_surplus():
+    # 2 hardware slices x 4 devices; topology wants 2 slices x 2 —
+    # selection must take per-slice prefixes, not the positional
+    # prefix (which would land entirely in slice 0).
+    devs = ([_FakeDev(i, slice_index=0) for i in range(4)]
+            + [_FakeDev(4 + i, process_index=1, slice_index=1)
+               for i in range(4)])
+    groups = group_devices_by_slice(devs, 2, per=2)
+    assert [d.id for d in groups[0]] == [0, 1]
+    assert [d.id for d in groups[1]] == [4, 5]
+    with pytest.raises(ValueError, match="topology needs 5 per slice"):
+        group_devices_by_slice(devs, 2, per=5)
+    with pytest.raises(ValueError, match="hardware reports 2 slice"):
+        group_devices_by_slice(devs, 4, per=2)
+
+
+def test_single_hardware_slice_refuses_split():
+    # All devices on ONE real slice: splitting it would put the "DCN"
+    # axis on ICI — raise unless explicitly simulating.
+    devs = [_FakeDev(i, slice_index=0) for i in range(8)]
+    with pytest.raises(ValueError, match="allow_split_slices"):
+        group_devices_by_slice(devs, 2)
+    groups = group_devices_by_slice(devs, 2, allow_split_slices=True)
+    assert [len(g) for g in groups] == [4, 4]
+
+
+def test_grouping_processes_with_surplus_devices():
+    # Surplus devices must not defeat process grouping: 2 procs x 4,
+    # topology wants 2 slices x 2 — per-process prefixes, never the
+    # positional prefix (all proc-0).
+    devs = [_FakeDev(i, process_index=i // 4) for i in range(8)]
+    groups = group_devices_by_slice(devs, 2, per=2)
+    assert [{d.process_index for d in g} for g in groups] == [{0}, {1}]
+    assert [d.id for d in groups[1]] == [4, 5]
+
+
+def test_grouping_processes_as_slices():
+    # 2 processes x 4 devices, no slice ids: processes are the slices.
+    devs = [_FakeDev(i, process_index=i // 4) for i in range(8)]
+    groups = group_devices_by_slice(devs, 2)
+    assert [{d.process_index for d in g} for g in groups] == [{0}, {1}]
+    # 4 sub-process slices: blocks stay inside one process — allowed.
+    groups4 = group_devices_by_slice(devs, 4)
+    assert all(len({d.process_index for d in g}) == 1 for g in groups4)
+
+
+def test_grouping_rejects_slice_straddling_processes():
+    # 3 processes x 4 devices into 2 slices: any equal split puts one
+    # slice across a process boundary (ICI collectives over DCN) —
+    # must raise, not silently degrade.
+    devs = [_FakeDev(i, process_index=i // 4) for i in range(12)]
+    with pytest.raises(ValueError, match="straddling a process boundary"):
+        group_devices_by_slice(devs, 2)
+
+
+def test_slice_mesh_geometry():
+    topo = SliceTopology(num_slices=2, inner=MeshSpec(fsdp=2, tp=2),
+                         cross="dp")
+    smesh = make_slice_mesh(topo, jax.devices()[:8])
+    assert smesh.num_slices == 2
+    assert smesh.dcn_axis == "dp"
+    assert dict(smesh.shape) == {"dp": 2, "fsdp": 2, "pp": 1, "sp": 1,
+                                 "tp": 2}
+    # each dp row is exactly one slice's devices
+    grid = smesh.devices
+    for s in range(2):
+        assert set(grid[s].flatten()) == set(smesh.slice_devices(s))
+    # per-slice ICI submesh has the inner layout
+    sub = smesh.slice_submesh(1)
+    assert dict(sub.shape) == {"dp": 1, "fsdp": 2, "pp": 1, "sp": 1,
+                               "tp": 2}
+    assert set(sub.devices.flatten()) == set(smesh.slice_devices(1))
+    d = smesh.describe()
+    assert d["slices"] == 2 and d["dcn_axis"] == "dp"
+    assert d["global"]["dp"] == 2
+
+
+def test_cross_axis_other_than_dp():
+    # "tp within slice, fsdp across slices" — any axis can ride DCN.
+    topo = SliceTopology(num_slices=4, inner=MeshSpec(tp=2), cross="fsdp")
+    smesh = make_slice_mesh(topo, jax.devices()[:8])
+    assert dict(smesh.shape)["fsdp"] == 4
+    assert smesh.ici_axes == ("dp", "pp", "sp", "tp")
+    grid = smesh.devices  # (dp, fsdp, pp, sp, tp)
+    for s in range(4):
+        assert set(grid[:, s].flatten()) == set(smesh.slice_devices(s))
+
+
+def test_train_step_slice_mesh_matches_flat_mesh():
+    """fsdp within slice + dp across slices, numerically identical to
+    the same layout built as one flat mesh."""
+    from ray_tpu.models import (
+        TransformerConfig, init_state, make_optimizer, make_train_step)
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=1,
+                            n_heads=2, n_kv_heads=2, d_ff=64,
+                            max_seq_len=32)
+    tx = make_optimizer(total_steps=3)
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (8, 16)).astype(np.int32)
+
+    def run(mesh):
+        with mesh:
+            state = init_state(jax.random.PRNGKey(0), cfg, tx, mesh)
+            step = make_train_step(cfg, tx, mesh)
+            sharded = jax.device_put(
+                tokens, NamedSharding(mesh, P(("dp", "fsdp"), "sp")))
+            losses = []
+            for _ in range(2):
+                state, m = step(state, {"tokens": sharded})
+                losses.append(float(m["loss"]))
+        return losses
+
+    topo = SliceTopology(num_slices=2, inner=MeshSpec(fsdp=4), cross="dp")
+    smesh = make_slice_mesh(topo, jax.devices()[:8])
+    slice_losses = run(smesh.mesh)
+    plain_losses = run(make_mesh(MeshSpec(dp=2, fsdp=4),
+                                 jax.devices()[:8]))
+    assert all(np.isfinite(l) for l in slice_losses)
+    np.testing.assert_allclose(slice_losses, plain_losses, rtol=1e-5)
+
+
+def test_two_simulated_slices_processes():
+    """Two processes = two slices; cross-slice dp grad sync crosses the
+    process boundary (the simulated DCN transport), numerics equal to
+    the flat single-mesh run."""
+    results = spawn_local_group(
+        os.path.join(HERE, "slice_member.py"),
+        num_processes=2, devices_per_process=4, timeout=600)
+    for r in results:
+        assert r.returncode == 0, r.stdout[-3000:]
+        assert "SLICE-OK" in r.stdout
+        assert "'slices': 2" in r.stdout
+    losses = {line.split("losses=")[1]
+              for r in results for line in r.stdout.splitlines()
+              if "SLICE-OK" in line}
+    assert len(losses) == 1, losses
