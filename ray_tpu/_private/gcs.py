@@ -51,6 +51,18 @@ class ActorInfo:
     death_cause: str = ""
     creation_spec: object = None
     class_name: str = ""
+    # Detached actors (reference: lifetime="detached", GcsActorManager
+    # ownership): registered cluster-wide, survive their creating
+    # driver; node_id records the hosting raylet so later drivers can
+    # route calls, method_names lets get_actor build a handle without
+    # the creating driver's function registry.
+    lifetime: Optional[str] = None
+    node_id: Optional["NodeID"] = None
+    method_names: Tuple[str, ...] = ()
+
+    @property
+    def detached(self) -> bool:
+        return self.lifetime == "detached"
 
 
 @dataclass
@@ -132,6 +144,15 @@ class GcsLite:
             if death_cause:
                 info.death_cause = death_cause
         self.publisher.publish("ACTOR", (state, actor_id))
+
+    def update_actor_location(self, actor_id: ActorID,
+                              node_id: Optional[NodeID]) -> None:
+        """Record the raylet hosting this actor (detached-actor
+        routing: later drivers resolve the node from here)."""
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is not None:
+                info.node_id = node_id
 
     def get_actor_info(self, actor_id: ActorID) -> Optional[ActorInfo]:
         with self._lock:

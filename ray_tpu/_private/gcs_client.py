@@ -109,6 +109,12 @@ class GcsClient:
         with self._cache_lock:
             self._actor_cache.pop(actor_id, None)
 
+    def update_actor_location(self, actor_id: ActorID,
+                              node_id) -> None:
+        self._call("update_actor_location", actor_id, node_id)
+        with self._cache_lock:
+            self._actor_cache.pop(actor_id, None)
+
     def get_actor_info(self, actor_id: ActorID) -> Optional[ActorInfo]:
         with self._cache_lock:
             info = self._actor_cache.get(actor_id)

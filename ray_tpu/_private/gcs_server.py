@@ -73,6 +73,9 @@ class GcsServer:
         s.register("update_actor_state",
                    lambda ctx, aid, st, cause: self._update_actor_state(
                        aid, st, cause))
+        s.register("update_actor_location",
+                   lambda ctx, aid, nid:
+                   self.state.update_actor_location(aid, nid))
         s.register("get_actor_info",
                    lambda ctx, aid: self.state.get_actor_info(aid))
         s.register("get_named_actor",
@@ -103,6 +106,7 @@ class GcsServer:
             # coalesces snapshots
             for method in ("register_node", "remove_node",
                            "register_actor", "update_actor_state",
+                           "update_actor_location",
                            "kv_put", "kv_del", "next_job_id"):
                 self._wrap_dirty(method)
             self._persist_thread = threading.Thread(

@@ -220,7 +220,7 @@ class NestedClient:
 
     def create_actor(self, fn_descriptor: FunctionDescriptor,
                      args: tuple, kwargs: dict, options: TaskOptions,
-                     class_name: str):
+                     class_name: str, method_names: tuple = ()):
         from ray_tpu._private.ids import ActorID
         arg_descs, kwargs_keys = self._ser_args(args, kwargs)
         options_dict = {f: getattr(options, f)
@@ -229,7 +229,8 @@ class NestedClient:
         fid = fn_descriptor.function_id
         actor_id_b = self._client.call(
             "nested_create_actor", fid, self._fn_shipment(fid),
-            class_name, arg_descs, kwargs_keys, options_dict)
+            class_name, arg_descs, kwargs_keys, options_dict,
+            tuple(method_names))
         return ActorID(actor_id_b)
 
     def submit_actor_task(self, actor_id, method_name: str, args: tuple,

@@ -498,6 +498,10 @@ class NodeManagerGroup:
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             payload["actor_id"] = spec.actor_creation_id.binary()
             payload["max_concurrency"] = spec.max_concurrency
+            if spec.lifetime == "detached":
+                # The raylet must keep this actor when our connection
+                # goes away (detached lifetime).
+                payload["detached"] = True
         fid = spec.function.function_id
         if fid not in handle.known_functions:
             payload["function_blob"] = self._function_blob(fid)
@@ -714,6 +718,49 @@ class NodeManagerGroup:
         with self._lock:
             entry = self._actor_workers.get(actor_id)
             return entry[1] if entry else None
+
+    def actor_node(self, actor_id: ActorID) -> Optional[NodeID]:
+        with self._lock:
+            entry = self._actor_workers.get(actor_id)
+            return entry[0] if entry else None
+
+    def pick_remote_node(self, demand: Dict[str, float]
+                         ) -> Optional[NodeID]:
+        """An alive remote raylet that fits ``demand`` (detached-actor
+        placement: anything but the driver-local raylets). Nodes with
+        the capacity FREE beat merely-feasible (busy) ones; the busy
+        fallback pairs with hard affinity — the creation queues until
+        the node frees rather than degrading to a local raylet."""
+        best, best_key = None, (-1, -1.0)
+        with self._lock:
+            remotes = {nid: h for nid, h in self._remote_nodes.items()
+                       if h.alive}
+        for nid in remotes:
+            node = self.cluster_resources.get_node(nid)
+            if node is None or not node.is_feasible(demand):
+                continue
+            key = (1 if node.is_available(demand) else 0,
+                   node.available.get("CPU", 0.0))
+            if key > best_key:
+                best, best_key = nid, key
+        return best
+
+    def ensure_remote_actor_route(self, actor_id: ActorID,
+                                  node_id: NodeID) -> bool:
+        """Route calls for an actor THIS driver did not create (a
+        detached actor found via the GCS): register a RemoteActorWorker
+        over the hosting raylet's channel. Returns False when that
+        raylet is not attached/alive."""
+        with self._lock:
+            if actor_id in self._actor_workers:
+                return True
+            handle = self._remote_nodes.get(node_id)
+        if handle is None or not handle.alive:
+            return False
+        self.register_actor_worker(
+            actor_id, node_id,
+            RemoteActorWorker(handle, actor_id.binary()), {})
+        return True
 
     def worker_core_addr(self, actor_id: ActorID,
                          timeout: float = 30.0):
@@ -1525,7 +1572,10 @@ class NodeManagerGroup:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def shutdown(self) -> None:
+    def shutdown(self, leave_remote_nodes: bool = False) -> None:
+        """``leave_remote_nodes``: this driver JOINED a cluster it does
+        not own — detach from its raylets without shutting them down
+        (nodes this driver spawned itself are always stopped)."""
         self._shutdown = True
         self._wake.set()
         with self._lock:
@@ -1534,10 +1584,11 @@ class NodeManagerGroup:
             self._remote_nodes.clear()
         for handle in remotes:
             handle.alive = False    # suppress on_close node-lost handling
-            try:
-                handle.client.call("shutdown", timeout=2)
-            except Exception:
-                pass
+            if not leave_remote_nodes or handle.proc is not None:
+                try:
+                    handle.client.call("shutdown", timeout=2)
+                except Exception:
+                    pass
             handle.client.close()
             if handle.proc is not None:
                 try:

@@ -40,7 +40,7 @@ from typing import Dict, List, Optional, Tuple
 from ray_tpu._private.config import get_config
 from ray_tpu._private.gcs import NodeInfo
 from ray_tpu._private.gcs_client import GcsClient
-from ray_tpu._private.ids import NodeID, ObjectID
+from ray_tpu._private.ids import ActorID, NodeID, ObjectID
 from ray_tpu._private.object_store import ShmStore, _segment_name
 from ray_tpu._private.object_transfer import (
     ObjectLocationError,
@@ -86,6 +86,18 @@ class RayletServer:
         self._running: Dict[bytes, BaseWorker] = {}   # task_id -> worker
         self._actor_workers: Dict[bytes, BaseWorker] = {}
         self._creation_tasks: Dict[bytes, bytes] = {}  # actor_id -> task_id
+        # Detached actors (lifetime="detached"): survive their creating
+        # driver's connection; everything else is reaped when its
+        # owner's channel closes (reference: GcsActorManager owns
+        # detached actors, workers of a dead job are cleaned up).
+        self._detached: set = set()                    # actor_id bytes
+        self._actor_ctx: Dict[bytes, ConnectionContext] = {}
+        self._orphaned_creations: set = set()          # owner died mid-create
+        # Completion routing: pushes go to the connection that
+        # SUBMITTED the task, so several drivers can share this raylet
+        # (the detached-actor case) without stealing each other's
+        # completions; _owner_ctx stays as the fallback.
+        self._task_ctx: Dict[bytes, ConnectionContext] = {}
         # Authoritative local usage: what running tasks and resident
         # actors nominally demand — the heartbeat reports total minus
         # this (reference: LocalResourceManager's available view).
@@ -111,6 +123,7 @@ class RayletServer:
         self.server.register("cancel_task", self._handle_cancel_task)
         self.server.register("adjust_pool", self._handle_adjust_pool)
         self.server.register("shutdown", lambda ctx: self._request_shutdown())
+        self.server.on_disconnect(self._on_conn_disconnect)
 
         self._dispatch_thread = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="rtpu-raylet-disp")
@@ -146,11 +159,86 @@ class RayletServer:
             self._owner_ctx = ctx
         return "ok"
 
-    def _push_owner(self, topic: str, payload) -> None:
+    def _push_owner(self, topic: str, payload,
+                    ctx: Optional[ConnectionContext] = None) -> None:
+        """Push to the submitting connection when known (``ctx``),
+        falling back to the registered owner channel."""
+        if ctx is not None and ctx.push(topic, payload):
+            return
         with self._owner_lock:
-            ctx = self._owner_ctx
-        if ctx is None or not ctx.push(topic, payload):
+            owner = self._owner_ctx
+        if owner is None or owner is ctx or not owner.push(topic, payload):
             logger.warning("owner channel gone; dropping %s", topic)
+
+    def _ctx_for_task(self, task_id: bytes, pop: bool = False
+                      ) -> Optional[ConnectionContext]:
+        with self._lock:
+            if pop:
+                return self._task_ctx.pop(task_id, None)
+            return self._task_ctx.get(task_id)
+
+    def _on_conn_disconnect(self, ctx: ConnectionContext) -> None:
+        """A driver's channel closed. Reap its non-detached actors
+        (nothing will ever call them again); keep detached ones."""
+        with self._owner_lock:
+            if self._owner_ctx is ctx:
+                self._owner_ctx = None
+        doomed: List[bytes] = []
+        with self._lock:
+            for tid in [t for t, c in self._task_ctx.items() if c is ctx]:
+                self._task_ctx.pop(tid, None)
+            for aid in [a for a, c in self._actor_ctx.items() if c is ctx]:
+                self._actor_ctx.pop(aid, None)
+                if aid in self._detached:
+                    continue
+                if aid in self._actor_workers:
+                    doomed.append(aid)
+                    continue
+                # Creation not finished: either mid-execution
+                # (_creation_tasks) or still queued for dispatch. Purge
+                # queued payloads outright; anything already executing
+                # reaps at actor_ready via the orphan mark.
+                purged = False
+                for payload in list(self._dispatch_queue):
+                    if (payload.get("type") == "create_actor"
+                            and payload.get("actor_id") == aid):
+                        self._dispatch_queue.remove(payload)
+                        purged = True
+                if not purged:
+                    self._orphaned_creations.add(aid)
+        for aid in doomed:
+            logger.info("reaping actor %s: owner disconnected",
+                        aid.hex()[:8])
+            self._reap_actor(aid, "owner disconnected")
+
+    def _forget_actor(self, actor_id: bytes, cause: str) -> None:
+        """Shared detached-death bookkeeping: drop the ctx/detached
+        marks and, for detached actors, record the death in the GCS —
+        the creating driver may be long gone, so this raylet is the one
+        observer."""
+        with self._lock:
+            self._actor_ctx.pop(actor_id, None)
+            was_detached = actor_id in self._detached
+            self._detached.discard(actor_id)
+        if was_detached and self.gcs is not None:
+            try:
+                self.gcs.update_actor_state(
+                    ActorID(actor_id), "DEAD", death_cause=cause)
+            except Exception:
+                pass
+
+    def _reap_actor(self, actor_id: bytes, cause: str) -> None:
+        with self._lock:
+            worker = self._actor_workers.pop(actor_id, None)
+            self._actor_demand.pop(actor_id, None)
+        if worker is not None:
+            try:
+                worker.send(("shutdown",))
+            except Exception:
+                pass
+            worker.kill()
+            self.worker_pool.remove_worker(worker)
+        self._forget_actor(actor_id, cause)
 
     # -- lease / submit path -------------------------------------------
 
@@ -165,6 +253,12 @@ class RayletServer:
         if blob is not None:
             self._functions[payload["function_id"]] = blob
         with self._lock:
+            self._task_ctx[payload["task_id"]] = ctx
+            if payload["type"] == "create_actor":
+                aid = payload["actor_id"]
+                self._actor_ctx[aid] = ctx
+                if payload.pop("detached", False):
+                    self._detached.add(aid)
             self._dispatch_queue.append(payload)
         self._wake.set()
         return "ok"
@@ -182,6 +276,8 @@ class RayletServer:
         if blob_updates:
             self._functions.update(blob_updates)
         with self._lock:
+            for payload in payloads:
+                self._task_ctx[payload["task_id"]] = ctx
             self._dispatch_queue.extend(payloads)
         self._wake.set()
         return "ok"
@@ -205,7 +301,8 @@ class RayletServer:
         if queued:
             self._push_owner("task_done", {
                 "task_id": task_id, "results": [], "error_blob": None,
-                "system_error": "cancelled by owner"})
+                "system_error": "cancelled by owner"},
+                ctx=self._ctx_for_task(task_id, pop=True))
             return
         if worker is None:
             return
@@ -226,16 +323,7 @@ class RayletServer:
 
     def _handle_kill_actor(self, ctx: ConnectionContext,
                            actor_id: bytes) -> None:
-        with self._lock:
-            worker = self._actor_workers.pop(actor_id, None)
-            self._actor_demand.pop(actor_id, None)
-        if worker is not None:
-            try:
-                worker.send(("shutdown",))
-            except Exception:
-                pass
-            worker.kill()
-            self.worker_pool.remove_worker(worker)
+        self._reap_actor(actor_id, "killed")
 
     def _handle_dump_stacks(self, ctx) -> dict:
         """On-demand host profiling (reference: the dashboard
@@ -322,7 +410,8 @@ class RayletServer:
             TaskError(err, payload.get("name", "?"), str(err))).to_bytes()
         self._push_owner("task_done", {
             "task_id": payload["task_id"], "results": [],
-            "error_blob": blob, "system_error": None})
+            "error_blob": blob, "system_error": None},
+            ctx=self._ctx_for_task(payload["task_id"], pop=True))
 
     def _dispatch_actor_task(self, payload: dict) -> None:
         actor_id = payload["actor_id"]
@@ -331,7 +420,8 @@ class RayletServer:
         if worker is None or not worker.alive:
             self._push_owner("task_done", {
                 "task_id": payload["task_id"], "results": [],
-                "error_blob": None, "system_error": "actor worker dead"})
+                "error_blob": None, "system_error": "actor worker dead"},
+                ctx=self._ctx_for_task(payload["task_id"], pop=True))
             return
         self._run_on_worker(worker, payload, actor=True)
 
@@ -345,7 +435,8 @@ class RayletServer:
             self._push_owner("task_done", {
                 "task_id": payload["task_id"], "results": [],
                 "error_blob": None, "system_error": f"lost argument: {e}",
-                "lost_arg": getattr(e, "oid_bytes", None)})
+                "lost_arg": getattr(e, "oid_bytes", None)},
+                ctx=self._ctx_for_task(payload["task_id"], pop=True))
             return
         fid = payload["function_id"]
         try:
@@ -370,7 +461,8 @@ class RayletServer:
             self._push_owner("task_done", {
                 "task_id": payload["task_id"], "results": [],
                 "error_blob": None,
-                "system_error": f"worker send failed: {e}"})
+                "system_error": f"worker send failed: {e}"},
+                ctx=self._ctx_for_task(payload["task_id"], pop=True))
 
     def _localize_args(self, payload: dict) -> None:
         """Rewrite ("pull", oid, addr, size) arg descriptors into local
@@ -463,7 +555,8 @@ class RayletServer:
                 else:
                     shipped.append((oid_b, kind, data, contained))
             self._push_owner("task_stream", {"task_id": task_id,
-                                             "results": shipped})
+                                             "results": shipped},
+                             ctx=self._ctx_for_task(task_id))
             return
         if op == "done":
             _, task_id, results, err_blob = reply[:4]
@@ -489,7 +582,8 @@ class RayletServer:
             self._push_owner("task_done", {
                 "task_id": task_id, "results": shipped,
                 "error_blob": err_blob, "system_error": None,
-                "timings": timings})
+                "timings": timings},
+                ctx=self._ctx_for_task(task_id, pop=True))
         elif op == "actor_ready":
             _, actor_id, err_blob = reply
             with self._lock:
@@ -500,7 +594,10 @@ class RayletServer:
                     # the creation demand becomes the actor's standing
                     # allocation for its lifetime
                     demand = self._running_demand.pop(tid, {})
-            if err_blob is None:
+                orphaned = actor_id in self._orphaned_creations
+                self._orphaned_creations.discard(actor_id)
+                creation_ctx = self._actor_ctx.get(actor_id)
+            if err_blob is None and not orphaned:
                 with self._lock:
                     self._actor_workers[actor_id] = worker
                     if demand:
@@ -511,8 +608,12 @@ class RayletServer:
                     worker.send(("shutdown",))
                 except Exception:
                     pass
+                if orphaned:
+                    return   # nobody left to tell
             self._push_owner("actor_ready", {
-                "actor_id": actor_id, "error_blob": err_blob})
+                "actor_id": actor_id, "error_blob": err_blob},
+                ctx=(self._ctx_for_task(tid, pop=True)
+                     if tid is not None else creation_ctx))
 
     def _on_worker_death(self, worker: BaseWorker) -> None:
         self.worker_pool.remove_worker(worker)
@@ -533,9 +634,14 @@ class RayletServer:
         for tid in dead_tasks:
             self._push_owner("task_done", {
                 "task_id": tid, "results": [], "error_blob": None,
-                "system_error": "worker process died while executing task"})
+                "system_error": "worker process died while executing task"},
+                ctx=self._ctx_for_task(tid, pop=True))
         for aid in dead_actors:
-            self._push_owner("actor_died", {"actor_id": aid})
+            with self._lock:
+                creation_ctx = self._actor_ctx.get(aid)
+            self._forget_actor(aid, "worker process died")
+            self._push_owner("actor_died", {"actor_id": aid},
+                             ctx=creation_ctx)
         self._wake.set()
 
     # -- gcs heartbeat -------------------------------------------------

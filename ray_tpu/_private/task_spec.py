@@ -134,6 +134,7 @@ class TaskSpec:
     max_restarts: int = 0
     max_task_retries: int = 0
     max_concurrency: int = 1
+    lifetime: Optional[str] = None   # None | "detached"
     name: str = ""
     runtime_env: Optional[dict] = None
     # Streaming generator task: returns yield incrementally; return_ids

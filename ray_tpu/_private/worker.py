@@ -721,7 +721,7 @@ class Worker:
 
     def _nested_create_actor(self, ctx, fid: bytes, fn_blob,
                              class_name: str, arg_descs, kwargs_keys,
-                             options_dict) -> bytes:
+                             options_dict, method_names=()) -> bytes:
         if fn_blob is not None:
             with self._functions_lock:
                 self._functions.setdefault(fid, fn_blob)
@@ -730,7 +730,8 @@ class Worker:
                                         name=class_name)
         actor_id = self.create_actor(descriptor, args, kwargs,
                                      TaskOptions(**options_dict),
-                                     class_name)
+                                     class_name,
+                                     method_names=tuple(method_names))
         return actor_id.binary()
 
     def _nested_actor_task(self, ctx, actor_id_b: bytes, method: str,
@@ -1261,7 +1262,8 @@ class Worker:
 
     def create_actor(self, fn_descriptor: FunctionDescriptor, args: tuple,
                      kwargs: dict, options: TaskOptions,
-                     class_name: str) -> ActorID:
+                     class_name: str,
+                     method_names: tuple = ()) -> ActorID:
         actor_id = ActorID.of(self.job_id)
         task_id = self.next_task_id()
         spec_args: List[TaskArg] = []
@@ -1270,6 +1272,25 @@ class Worker:
         max_restarts = (options.max_restarts
                         if options.max_restarts is not None
                         else get_config().actor_max_restarts)
+        detached = options.lifetime == "detached"
+        if detached and options.scheduling_strategy is None:
+            # A detached actor must outlive this driver, so it must not
+            # land on the driver's in-process raylet; prefer a
+            # persistent (cluster) raylet when one exists.
+            target = self.node_group.pick_remote_node(demand)
+            if target is not None:
+                from ray_tpu.util.scheduling_strategies import (
+                    NodeAffinitySchedulingStrategy)
+                # HARD affinity: a soft one would fall back to the
+                # driver-local raylet under contention, silently
+                # breaking the survival contract. Queuing on a busy
+                # (but feasible) cluster node is the correct wait.
+                options.scheduling_strategy = NodeAffinitySchedulingStrategy(
+                    node_id=target.hex(), soft=False)
+            elif self._join_address is not None:
+                raise ValueError(
+                    "detached actor needs a cluster raylet to host it, "
+                    "but no remote nodes are attached")
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -1284,6 +1305,7 @@ class Worker:
             max_restarts=max_restarts,
             max_task_retries=options.max_task_retries,
             max_concurrency=max(1, options.max_concurrency),
+            lifetime=options.lifetime,
             scheduling_strategy=options.scheduling_strategy,
             name=options.name or class_name,
             runtime_env=_validate_runtime_env(options.runtime_env),
@@ -1294,7 +1316,9 @@ class Worker:
             actor_id=actor_id, name=options.name,
             namespace=options.namespace or "default",
             max_restarts=max_restarts,
-            creation_spec=spec, class_name=class_name)
+            creation_spec=spec, class_name=class_name,
+            lifetime=options.lifetime,
+            method_names=tuple(method_names))
         self.gcs.register_actor(info)
         from ray_tpu._private import export
         export.emit("ACTOR", {"actor_id": actor_id.hex(),
@@ -1313,6 +1337,12 @@ class Worker:
                                 system_error) -> None:
         actor_id = spec.actor_creation_id
         if err_blob is None and system_error is None:
+            if spec.lifetime == "detached":
+                # Publish the hosting raylet so later drivers can
+                # route calls to this actor after we exit.
+                node_id = self.node_group.actor_node(actor_id)
+                if node_id is not None:
+                    self.gcs.update_actor_location(actor_id, node_id)
             self.gcs.update_actor_state(actor_id, "ALIVE")
             from ray_tpu._private import export
             export.emit("ACTOR", {"actor_id": actor_id.hex(),
@@ -1327,12 +1357,35 @@ class Worker:
                                   "cause": "creation failed"})
             self._fail_actor_queue(actor_id, err_blob)
 
+    def _ensure_actor_route(self, actor_id: ActorID, info) -> None:
+        """Make a detached actor created by ANOTHER driver callable
+        from this one: build the remote route from the GCS-recorded
+        hosting node and initialize the call queue."""
+        with self._actor_lock:
+            have_queue = actor_id in self._actor_queues
+        if have_queue and self.node_group.actor_worker(actor_id) is not None:
+            return
+        node_id = getattr(info, "node_id", None)
+        if node_id is None:
+            return   # locally-created actor mid-creation: normal path
+        if not self.node_group.ensure_remote_actor_route(actor_id, node_id):
+            from ray_tpu.exceptions import ActorDiedError
+            raise ActorDiedError(
+                f"actor {info.class_name} is hosted on node "
+                f"{node_id.hex()[:8]}, which is not reachable")
+        with self._actor_lock:
+            self._actor_queues.setdefault(actor_id, deque())
+            self._actor_seq.setdefault(actor_id, 0)
+            # Another driver owns restarts; we never restart it.
+            self._actor_restarts.setdefault(actor_id, 0)
+
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           args: tuple, kwargs: dict,
                           options: TaskOptions) -> List[ObjectRef]:
         info = self.gcs.get_actor_info(actor_id)
         if info is None:
             raise ValueError(f"unknown actor {actor_id}")
+        self._ensure_actor_route(actor_id, info)
         task_id = TaskID.of(actor_id)
         spec_args: List[TaskArg] = []
         kwargs_keys = self.build_args(args, kwargs, spec_args)
@@ -1344,6 +1397,14 @@ class Worker:
             seq = self._actor_seq[actor_id] = self._actor_seq.get(actor_id,
                                                                   0) + 1
         creation = self._actor_specs.get(actor_id)
+        if creation is None:
+            # An actor created by another driver (detached): the GCS
+            # carries its creation spec — calls need the real function
+            # id so the hosting raylet/worker resolve the class.
+            creation = getattr(info, "creation_spec", None)
+            if creation is not None:
+                with self._actor_lock:
+                    self._actor_specs.setdefault(actor_id, creation)
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -1570,6 +1631,14 @@ class Worker:
                 self._fail_task(spec, ActorDiedError("actor died"))
 
     def kill_actor(self, actor_id: ActorID) -> None:
+        info = self.gcs.get_actor_info(actor_id)
+        if info is not None:
+            try:
+                # Detached actor created elsewhere: route to its raylet
+                # so the kill reaches the worker, not just the tables.
+                self._ensure_actor_route(actor_id, info)
+            except Exception:
+                pass
         with self._actor_lock:
             self._actor_restarts[actor_id] = 0
         self.node_group.release_actor(actor_id, kill_worker=True)
@@ -1600,7 +1669,27 @@ class Worker:
             # its objects die with the session (unlink segments)
             core.shutdown()
             _wc._core = None
-        self.node_group.shutdown()
+        joined = self._join_address is not None
+        if joined:
+            # Leaving a cluster we don't own: reap our NON-detached
+            # actors from its raylets (their raylet would otherwise
+            # keep them alive), keep detached ones running, and mark
+            # our actor table entries accordingly.
+            with self._actor_lock:
+                specs = dict(self._actor_specs)
+            for actor_id, spec in specs.items():
+                if spec.lifetime == "detached":
+                    continue
+                try:
+                    info = self.gcs.get_actor_info(actor_id)
+                    if info is not None and info.state != "DEAD":
+                        self.node_group.release_actor(actor_id,
+                                                      kill_worker=True)
+                        self.gcs.update_actor_state(
+                            actor_id, "DEAD", death_cause="driver exited")
+                except Exception:
+                    pass
+        self.node_group.shutdown(leave_remote_nodes=joined)
         self.shm_store.shutdown()
         self.device_store.shutdown()
         if self._gcs_proc is not None:

@@ -134,6 +134,15 @@ class ActorClass:
             if m is not None)
 
     def _remote(self, args, kwargs, options_dict) -> ActorHandle:
+        lifetime = options_dict.get("lifetime")
+        if lifetime not in (None, "detached", "non_detached"):
+            raise ValueError(
+                f"lifetime must be 'detached' or 'non_detached', "
+                f"got {lifetime!r}")
+        if lifetime == "detached" and not options_dict.get("name"):
+            raise ValueError(
+                "detached actors must be named (name=...) — the name "
+                "is how later drivers reach them via get_actor()")
         opts = TaskOptions(**{k: v for k, v in options_dict.items()
                               if k in TaskOptions.__dataclass_fields__})
         if "max_concurrency" not in options_dict \
@@ -154,7 +163,8 @@ class ActorClass:
                                    self._method_names())
         actor_id = w.create_actor(
             self._get_descriptor(), args, kwargs, opts,
-            class_name=self._cls.__name__)
+            class_name=self._cls.__name__,
+            method_names=self._method_names())
         return ActorHandle(actor_id, self._cls.__name__,
                            self._method_names())
 
@@ -173,14 +183,17 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
     info = global_worker().gcs.get_named_actor(name, namespace)
     if info is None or info.state == "DEAD":
         raise ValueError(f"no live actor named {name!r}")
-    spec = info.creation_spec
-    # Method names are derivable from the registered class on the driver.
-    import cloudpickle
-    cls = cloudpickle.loads(
-        global_worker()._get_function_blob(spec.function.function_id))
-    methods = tuple(n for n in dir(cls)
-                    if callable(getattr(cls, n, None))
-                    and not n.startswith("__"))
+    methods = tuple(getattr(info, "method_names", ()) or ())
+    if not methods:
+        # Pre-detached registrations: derive from the registered class
+        # (only possible on the creating driver).
+        import cloudpickle
+        spec = info.creation_spec
+        cls = cloudpickle.loads(
+            global_worker()._get_function_blob(spec.function.function_id))
+        methods = tuple(n for n in dir(cls)
+                        if callable(getattr(cls, n, None))
+                        and not n.startswith("__"))
     return ActorHandle(info.actor_id, info.class_name, methods)
 
 
