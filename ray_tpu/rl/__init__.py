@@ -11,6 +11,8 @@ gang (see ``ppo.py``).
 from ray_tpu.rl.env import CartPoleVec, VectorEnv, make_env, register_env
 from ray_tpu.rl.env_runner import EnvRunner, EnvRunnerGroup
 from ray_tpu.rl.dqn import DQN, DQNConfig, ReplayBuffer, init_q_params
+from ray_tpu.rl.impala import (
+    IMPALA, IMPALAConfig, AsyncEnvRunner, vtrace_targets)
 from ray_tpu.rl.ppo import PPO, PPOConfig, init_policy_params
 from ray_tpu.rl.multi_agent import (
     MultiAgentEnvRunner,
@@ -24,6 +26,7 @@ from ray_tpu.rl.multi_agent import (
 
 __all__ = [
     "PPO", "PPOConfig", "DQN", "DQNConfig", "ReplayBuffer",
+    "IMPALA", "IMPALAConfig", "AsyncEnvRunner", "vtrace_targets",
     "EnvRunner", "EnvRunnerGroup", "VectorEnv",
     "CartPoleVec", "make_env", "register_env", "init_policy_params",
     "init_q_params",

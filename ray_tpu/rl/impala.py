@@ -1,0 +1,373 @@
+"""IMPALA: decoupled async rollouts + V-trace off-policy learner.
+
+Reference: ``rllib/algorithms/impala/`` (and ``appo/``) [UNVERIFIED —
+mount empty, SURVEY.md §0]: rollout workers collect continuously with
+whatever weights they last received; the learner consumes stale
+trajectories as they arrive and corrects the off-policy gap with
+V-trace (Espeholt et al. 2018) importance weighting; weights broadcast
+periodically, never synchronously.
+
+TPU-native redesign, same split as PPO here:
+
+- rollout actors are ASYNC actors (the async-actor runtime,
+  ``worker_process.py``): ``collect`` yields to the event loop every
+  step, so a ``set_params`` broadcast lands MID-ROLLOUT — the behavior
+  policy can change inside one trajectory, which is exactly the
+  regime V-trace's per-step importance ratios handle (behavior log-p
+  is recorded per step from whatever params produced the action).
+- the driver never blocks a collection barrier: one collect is kept
+  in flight per runner; ``ray_tpu.wait`` harvests whichever finishes
+  first and the next collect is resubmitted BEFORE the learner
+  update runs, so actors are mid-episode while the learner steps.
+- the learner is ONE jitted program over a ``dp`` mesh: V-trace
+  targets (reverse ``lax.scan``), policy gradient, value and entropy
+  losses, and the adam step fused into a single launch.
+
+Staleness is observable: every rollout carries the params version it
+STARTED with; ``train()`` reports the consume-time lag
+(``policy_lag_max`` >= 1 is the decoupling signature — the learner
+advanced while that trajectory was being collected).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import ray_tpu
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+from ray_tpu.rl.config import AlgorithmConfigBase
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.env_runner import _policy_forward
+from ray_tpu.rl.ppo import _net, init_policy_params
+
+
+# --------------------------------------------------------------------------
+# V-trace targets (standalone: unit-testable against a numpy mirror)
+
+
+def vtrace_targets(values, last_value, rewards, not_done, rhos,
+                   gamma: float, rho_clip: float = 1.0,
+                   c_clip: float = 1.0):
+    """V-trace value targets and policy-gradient advantages.
+
+    All inputs time-major [T, B] (``last_value`` [B]); ``rhos`` are the
+    UNclipped importance ratios pi/mu per step. Returns (vs, pg_adv):
+    vs_t = V(x_t) + sum_k gamma^k (prod c) rho-clipped TD deltas, via
+    the reverse recursion vs_t = v_t + delta_t + gamma c_t (vs_{t+1} -
+    v_{t+1}); pg_adv_t = rho_t-clipped (r_t + gamma vs_{t+1} - v_t).
+    """
+    rho_c = jnp.minimum(rhos, rho_clip)
+    cs = jnp.minimum(rhos, c_clip)
+    v_next = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    deltas = rho_c * (rewards + gamma * not_done * v_next - values)
+
+    def step(vs_minus_v_next, xs):
+        delta_t, c_t, nd_t = xs
+        vs_minus_v = delta_t + gamma * nd_t * c_t * vs_minus_v_next
+        return vs_minus_v, vs_minus_v
+
+    _, vs_minus_v = jax.lax.scan(
+        step, jnp.zeros_like(last_value), (deltas, cs, not_done),
+        reverse=True)
+    vs = values + vs_minus_v
+    vs_next = jnp.concatenate(
+        [vs[1:], last_value[None]], axis=0)
+    pg_adv = rho_c * (rewards + gamma * not_done * vs_next - values)
+    return vs, pg_adv
+
+
+# --------------------------------------------------------------------------
+# async rollout actor
+
+
+class AsyncEnvRunner:
+    """Async actor: collects continuously with its CURRENT weights;
+    ``set_params`` broadcasts land between env steps, mid-rollout."""
+
+    def __init__(self, env_name: str, num_envs: int, seed: int = 0):
+        self.env = make_env(env_name, num_envs, seed)
+        self.rng = np.random.RandomState(seed + 20_000)
+        self.obs = self.env.observe()
+        self.params: Optional[Dict[str, np.ndarray]] = None
+        self.version = 0
+
+    async def set_params(self, params: Dict[str, np.ndarray],
+                         version: int) -> None:
+        self.params = params
+        self.version = version
+
+    async def collect(self, rollout_len: int) -> Dict[str, np.ndarray]:
+        T, B = rollout_len, self.env.num_envs
+        obs_buf = np.empty((T, B, self.env.obs_dim), np.float32)
+        act_buf = np.empty((T, B), np.int32)
+        logp_buf = np.empty((T, B), np.float32)
+        rew_buf = np.empty((T, B), np.float32)
+        done_buf = np.empty((T, B), bool)
+        version_start = self.version
+        for t in range(T):
+            # Yield to the event loop: a set_params call queued behind
+            # this rollout executes HERE — the behavior policy changes
+            # mid-trajectory, per-step logp stays truthful.
+            await asyncio.sleep(0)
+            obs_buf[t] = self.obs
+            logits = _policy_forward(self.params, self.obs)
+            z = logits - logits.max(axis=1, keepdims=True)
+            probs = np.exp(z)
+            probs /= probs.sum(axis=1, keepdims=True)
+            gumbel = -np.log(-np.log(
+                self.rng.uniform(1e-9, 1.0, logits.shape)))
+            actions = np.argmax(logits + gumbel, axis=1).astype(np.int32)
+            logp_buf[t] = np.log(
+                probs[np.arange(B), actions] + 1e-9).astype(np.float32)
+            act_buf[t] = actions
+            self.obs, rew_buf[t], done_buf[t] = self.env.step(actions)
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "rewards": rew_buf, "dones": done_buf,
+            "last_obs": self.obs.copy(),
+            "episode_returns": np.asarray(
+                self.env.drain_episode_returns(), np.float32),
+            "version_start": version_start,
+            "version_end": self.version,
+        }
+
+
+# --------------------------------------------------------------------------
+# config
+
+
+@dataclass
+class IMPALAConfig(AlgorithmConfigBase):
+    env: str = "CartPole"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 16
+    rollout_length: int = 64
+    batch_rollouts: int = 2        # rollouts consumed per learner step
+    broadcast_interval: int = 1    # learner steps between weight pushes
+    lr: float = 1e-3
+    gamma: float = 0.99
+    rho_clip: float = 1.0
+    c_clip: float = 1.0
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    hidden: int = 64
+    seed: int = 0
+    learner_devices: Optional[int] = None
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+# --------------------------------------------------------------------------
+# the algorithm
+
+
+class IMPALA:
+    """Tune-compatible iterative trainer: ``train()`` = harvest
+    ``batch_rollouts`` finished rollouts (resubmitting each runner's
+    next collect first) + one V-trace update + periodic broadcast."""
+
+    def __init__(self, config: IMPALAConfig):
+        self.config = config
+        ray_tpu.init()
+        probe = make_env(config.env, 1, 0)
+        self.obs_dim = probe.obs_dim
+        self.num_actions = probe.num_actions
+
+        self.params = init_policy_params(
+            jax.random.PRNGKey(config.seed), self.obs_dim,
+            self.num_actions, config.hidden)
+        self.opt_m = {k: np.zeros_like(v) for k, v in self.params.items()}
+        self.opt_v = {k: np.zeros_like(v) for k, v in self.params.items()}
+        self.iteration = 0
+        self._step_count = 0
+        self._version = 0
+
+        n_dev = config.learner_devices or len(jax.devices())
+        batch_envs = config.batch_rollouts * config.num_envs_per_runner
+        while n_dev > 1 and batch_envs % n_dev != 0:
+            n_dev -= 1
+        self.mesh = make_mesh(MeshSpec(dp=n_dev))
+        self._update = self._build_update()
+
+        actor_cls = ray_tpu.remote(AsyncEnvRunner)
+        self._runners = [
+            actor_cls.options(num_cpus=1).remote(
+                config.env, config.num_envs_per_runner,
+                config.seed + i * 1000)
+            for i in range(config.num_env_runners)]
+        ray_tpu.get([r.set_params.remote(self.params, 0)
+                     for r in self._runners], timeout=120)
+        # one collect in flight per runner, permanently
+        self._inflight: Dict[object, object] = {
+            r: r.collect.remote(config.rollout_length)
+            for r in self._runners}
+        self._recent_returns: List[float] = []
+
+    # -- jitted V-trace learner ----------------------------------------
+
+    def _build_update(self):
+        cfg = self.config
+        mesh = self.mesh
+        batch_sh = NamedSharding(mesh, P(None, "dp"))      # [T, B]
+        obs_sh = NamedSharding(mesh, P(None, "dp", None))
+        rep = NamedSharding(mesh, P())
+
+        def loss_fn(params, obs, actions, behavior_logp, rewards,
+                    not_done, last_obs):
+            logits, values = _net(params, obs)               # [T, B]
+            _, last_v = _net(params, last_obs)               # [B]
+            logp_all = jax.nn.log_softmax(logits)
+            target_logp = jnp.take_along_axis(
+                logp_all, actions[..., None], axis=-1)[..., 0]
+            rhos = jnp.exp(target_logp - behavior_logp)
+            vs, pg_adv = vtrace_targets(
+                jax.lax.stop_gradient(values),
+                jax.lax.stop_gradient(last_v),
+                rewards, not_done, jax.lax.stop_gradient(rhos),
+                cfg.gamma, cfg.rho_clip, cfg.c_clip)
+            pg_loss = -jnp.mean(target_logp * pg_adv)
+            vf_loss = jnp.mean((values - vs) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            return (pg_loss + cfg.vf_coeff * vf_loss
+                    - cfg.entropy_coeff * entropy)
+
+        def adam(p, m, v, g, t):
+            b1, b2, eps = 0.9, 0.999, 1e-8
+            m = jax.tree.map(lambda mi, gi: b1 * mi + (1 - b1) * gi, m, g)
+            v = jax.tree.map(lambda vi, gi: b2 * vi + (1 - b2) * gi ** 2,
+                             v, g)
+            mhat = jax.tree.map(lambda mi: mi / (1 - b1 ** t), m)
+            vhat = jax.tree.map(lambda vi: vi / (1 - b2 ** t), v)
+            p = jax.tree.map(
+                lambda pi, mi, vi: pi - cfg.lr * mi / (jnp.sqrt(vi) + eps),
+                p, mhat, vhat)
+            return p, m, v
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def update(params, opt_m, opt_v, obs, actions, behavior_logp,
+                   rewards, dones, last_obs, t):
+            not_done = 1.0 - dones.astype(jnp.float32)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, obs, actions, behavior_logp, rewards, not_done,
+                last_obs)
+            params, opt_m, opt_v = adam(params, opt_m, opt_v, grads, t)
+            return params, opt_m, opt_v, loss
+
+        self._shardings = (obs_sh, batch_sh, rep)
+        return update
+
+    # -- Trainable API -------------------------------------------------
+
+    def train(self) -> Dict:
+        cfg = self.config
+        t_start = time.perf_counter()
+        harvested: List[Dict[str, np.ndarray]] = []
+        while len(harvested) < cfg.batch_rollouts:
+            refs = list(self._inflight.values())
+            done, _ = ray_tpu.wait(refs, num_returns=1, timeout=300)
+            if not done:
+                raise TimeoutError(
+                    "no rollout finished within 300s — runner actors "
+                    "stalled or dead")
+            ref = done[0]
+            runner = next(r for r, v in self._inflight.items()
+                          if v is ref)
+            harvested.append(ray_tpu.get(ref))
+            # Resubmit BEFORE the update: the runner is already
+            # collecting its next trajectory while the learner steps.
+            self._inflight[runner] = runner.collect.remote(
+                cfg.rollout_length)
+
+        lags = [self._version - r["version_start"] for r in harvested]
+        for r in harvested:
+            self._recent_returns.extend(r["episode_returns"].tolist())
+        self._recent_returns = self._recent_returns[-100:]
+
+        obs = np.concatenate([r["obs"] for r in harvested], axis=1)
+        actions = np.concatenate([r["actions"] for r in harvested], axis=1)
+        logp = np.concatenate([r["logp"] for r in harvested], axis=1)
+        rewards = np.concatenate([r["rewards"] for r in harvested], axis=1)
+        dones = np.concatenate([r["dones"] for r in harvested], axis=1)
+        last_obs = np.concatenate([r["last_obs"] for r in harvested],
+                                  axis=0)
+
+        obs_sh, batch_sh, rep = self._shardings
+        self._step_count += 1
+        params, opt_m, opt_v, loss = self._update(
+            jax.device_put(self.params, rep),
+            jax.device_put(self.opt_m, rep),
+            jax.device_put(self.opt_v, rep),
+            jax.device_put(obs, obs_sh),
+            jax.device_put(actions, batch_sh),
+            jax.device_put(logp, batch_sh),
+            jax.device_put(rewards, batch_sh),
+            jax.device_put(dones, batch_sh),
+            jax.device_put(last_obs, NamedSharding(self.mesh, P("dp"))),
+            jnp.int32(self._step_count))
+        self.params = jax.tree.map(np.asarray, params)
+        self.opt_m = jax.tree.map(np.asarray, opt_m)
+        self.opt_v = jax.tree.map(np.asarray, opt_v)
+        self._version += 1
+        self.iteration += 1
+
+        if self._version % cfg.broadcast_interval == 0:
+            # fire-and-forget: runners pick the new weights up at their
+            # next step boundary, wherever they are in a trajectory
+            for r in self._runners:
+                r.set_params.remote(self.params, self._version)
+
+        mean_ret = (float(np.mean(self._recent_returns))
+                    if self._recent_returns else 0.0)
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "loss": float(loss),
+            "policy_lag_mean": float(np.mean(lags)),
+            "policy_lag_max": int(max(lags)),
+            "num_env_steps_sampled": (self.iteration * cfg.batch_rollouts
+                                      * cfg.rollout_length
+                                      * cfg.num_envs_per_runner),
+            "time_this_iter_s": time.perf_counter() - t_start,
+        }
+
+    # -- checkpointing -------------------------------------------------
+
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump({"params": self.params, "opt_m": self.opt_m,
+                         "opt_v": self.opt_v,
+                         "iteration": self.iteration,
+                         "step_count": self._step_count,
+                         "version": self._version}, f)
+
+    def restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.params = state["params"]
+        self.opt_m = state["opt_m"]
+        self.opt_v = state["opt_v"]
+        self.iteration = state["iteration"]
+        self._step_count = state["step_count"]
+        self._version = state["version"]
+        for r in self._runners:
+            r.set_params.remote(self.params, self._version)
+
+    def stop(self) -> None:
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
