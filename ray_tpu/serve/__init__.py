@@ -193,19 +193,23 @@ class Deployment:
     def __init__(self, target: Union[type, Callable], name: str,
                  num_replicas: int, ray_actor_options: Optional[dict],
                  autoscaling_config: Optional[dict],
-                 max_ongoing_requests: Optional[int] = None):
+                 max_ongoing_requests: Optional[int] = None,
+                 graceful_shutdown_timeout_s: float = 20.0):
         self._target = target
         self.name = name
         self.num_replicas = num_replicas
         self.ray_actor_options = dict(ray_actor_options or {})
         self.autoscaling_config = autoscaling_config
         self.max_ongoing_requests = max_ongoing_requests
+        self.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
 
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[int] = None,
                 ray_actor_options: Optional[dict] = None,
                 autoscaling_config: Optional[dict] = None,
-                max_ongoing_requests: Optional[int] = None) -> "Deployment":
+                max_ongoing_requests: Optional[int] = None,
+                graceful_shutdown_timeout_s: Optional[float] = None
+                ) -> "Deployment":
         return Deployment(
             self._target,
             name if name is not None else self.name,
@@ -215,7 +219,10 @@ class Deployment:
             autoscaling_config if autoscaling_config is not None
             else self.autoscaling_config,
             max_ongoing_requests if max_ongoing_requests is not None
-            else self.max_ongoing_requests)
+            else self.max_ongoing_requests,
+            graceful_shutdown_timeout_s
+            if graceful_shutdown_timeout_s is not None
+            else self.graceful_shutdown_timeout_s)
 
     def bind(self, *args, **kwargs) -> Application:
         return Application(self, args, kwargs)
@@ -225,16 +232,19 @@ def deployment(_target=None, *, name: Optional[str] = None,
                num_replicas: int = 1,
                ray_actor_options: Optional[dict] = None,
                autoscaling_config: Optional[dict] = None,
-               max_ongoing_requests: Optional[int] = None):
+               max_ongoing_requests: Optional[int] = None,
+               graceful_shutdown_timeout_s: float = 20.0):
     """``@serve.deployment`` decorator for classes and functions.
     ``max_ongoing_requests`` caps each replica's in-flight requests
     (admission control): excess callers wait in the router instead of
-    piling onto replicas."""
+    piling onto replicas. ``graceful_shutdown_timeout_s`` bounds the
+    drain wait when a replica retires (redeploy roll or downscale)."""
 
     def wrap(target):
         return Deployment(target, name or target.__name__, num_replicas,
                           ray_actor_options, autoscaling_config,
-                          max_ongoing_requests)
+                          max_ongoing_requests,
+                          graceful_shutdown_timeout_s)
 
     if _target is not None:
         return wrap(_target)
@@ -259,7 +269,8 @@ def run(app: Union[Application, Deployment], *, name: Optional[str] = None,
         dep_name, dep._target, app.init_args, app.init_kwargs,
         dep.num_replicas, actor_options=dep.ray_actor_options,
         autoscaling=autoscaling,
-        max_ongoing_requests=dep.max_ongoing_requests)
+        max_ongoing_requests=dep.max_ongoing_requests,
+        graceful_shutdown_timeout_s=dep.graceful_shutdown_timeout_s)
     if wait_for_healthy:
         controller.wait_healthy(dep_name, timeout=timeout)
     return DeploymentHandle(dep_name, replica_set)
@@ -281,15 +292,18 @@ def status() -> dict:
     return _get_controller().status()
 
 
-def start(http: bool = True, proxy_location: str = "driver"):
+def start(http: bool = True, proxy_location: str = "worker"):
     """Start serve, optionally with the HTTP ingress.
 
     ``proxy_location``:
-    - "driver": threaded server in the driver process (tests).
-    - "worker": the ingress runs in a WORKER process (the reference's
-      proxy-actor topology) — HTTP parsing and response serialization
-      stay off the driver's scheduling threads; the controller pushes
-      route-table updates to it.
+    - "worker" (default): the ingress runs in a WORKER process (the
+      reference's proxy-actor topology) — HTTP parsing and response
+      serialization stay off the driver's scheduling threads; the
+      controller pushes route-table updates to it. This is the
+      production topology and the one BASELINE.md's serve numbers use.
+    - "driver": threaded server in the driver process — TEST-ONLY
+      convenience (no worker spawn): ingress threads compete with the
+      driver's scheduling loop for CPU.
     """
     global _worker_proxy
     if proxy_location not in ("driver", "worker"):

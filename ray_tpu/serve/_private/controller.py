@@ -50,6 +50,15 @@ class DeploymentInfo:
     replicas: List = field(default_factory=list)
     replica_set: ReplicaSet = None
     state: str = "DEPLOYING"     # DEPLOYING|HEALTHY|DELETING
+    # Version of this deploy (reference: DeploymentVersion). Replicas
+    # are tagged with the generation that created them; a redeploy
+    # bumps it and the reconcile loop ROLLS old-generation replicas
+    # out one at a time, each replacement gated on the new replica's
+    # health — never a mass kill.
+    generation: int = 0
+    # Bounded wait for a retiring replica's in-flight requests
+    # (reference: graceful_shutdown_timeout_s + wait_loop).
+    graceful_shutdown_timeout_s: float = 20.0
     _last_scale_change: float = 0.0
     _scale_pressure_since: Optional[float] = None
 
@@ -65,6 +74,7 @@ class ServeController:
         # worker-hosted ingress proxies fed by route-table pushes
         self._proxies: List = []
         self._pushed_routes: Dict[str, tuple] = {}
+        self._draining: Dict[object, str] = {}   # handle -> deployment
         self._shutdown = threading.Event()
         # Serializes reconcile passes: deploy() reconciles inline while
         # the background loop also runs — unserialized, both see
@@ -114,7 +124,8 @@ class ServeController:
                init_kwargs: dict, num_replicas: int,
                actor_options: Optional[dict] = None,
                autoscaling: Optional[AutoscalingConfig] = None,
-               max_ongoing_requests: Optional[int] = None
+               max_ongoing_requests: Optional[int] = None,
+               graceful_shutdown_timeout_s: float = 20.0
                ) -> ReplicaSet:
         info = DeploymentInfo(
             name=name,
@@ -123,6 +134,7 @@ class ServeController:
             num_replicas=num_replicas,
             actor_options=dict(actor_options or {}),
             autoscaling=autoscaling,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             replica_set=ReplicaSet(name))
         if autoscaling is not None:
             info.num_replicas = max(autoscaling.min_replicas,
@@ -131,8 +143,13 @@ class ServeController:
         with self._lock:
             old = self._deployments.get(name)
             if old is not None:
+                # Rolling update: the old generation KEEPS SERVING;
+                # reconcile replaces its replicas one health-gated
+                # step at a time (mass-killing here = dropped
+                # requests for the whole redeploy window).
                 info.replica_set = old.replica_set   # handles stay valid
-                self._kill_replicas(old.replicas)
+                info.generation = old.generation + 1
+                info.replicas = list(old.replicas)
             self._deployments[name] = info
             # inside the lock and after the old-set swap: a concurrent
             # redeploy must not leave the superseded deploy's cap on
@@ -173,6 +190,13 @@ class ServeController:
                     "target_replicas": info.num_replicas,
                     "live_replicas": len(info.replicas),
                     "ongoing_requests": info.replica_set.total_inflight(),
+                    "generation": info.generation,
+                    "updating": any(
+                        getattr(r, "_serve_gen", info.generation)
+                        != info.generation for r in info.replicas),
+                    "draining_replicas": sum(
+                        1 for n in self._draining.values()
+                        if n == name),
                 }
                 for name, info in self._deployments.items()
             }
@@ -230,9 +254,16 @@ class ServeController:
         if info.autoscaling is not None:
             self._autoscale(info)
 
-        # 3. converge toward target
-        while len(info.replicas) < info.num_replicas:
-            handle = self._create_replica(info)
+        # 3. converge toward target. Replicas are generation-tagged:
+        # during a rolling update both generations serve, and each
+        # retirement is gated on a new replica having passed health.
+        gen = info.generation
+        new_gen = [r for r in info.replicas
+                   if getattr(r, "_serve_gen", gen) == gen]
+        old_gen = [r for r in info.replicas if r not in new_gen]
+
+        while len(new_gen) < info.num_replicas:
+            handle = self._create_replica(info)   # health-gated (ping)
             if handle is None:
                 break
             with self._lock:
@@ -244,15 +275,85 @@ class ServeController:
                 self._kill_replicas([handle])
                 return
             info.replicas.append(handle)
-        while len(info.replicas) > info.num_replicas:
-            victim = info.replicas.pop()
-            self._kill_replicas([victim])
+            new_gen.append(handle)
+            # one-at-a-time roll: each healthy new replica retires one
+            # old-generation replica (drained, never killed in flight)
+            if old_gen:
+                victim = old_gen.pop(0)
+                info.replicas.remove(victim)
+                self._drain_replica(info, victim)
+        # all new-generation slots filled (vacuously so for a target of
+        # zero): retire any old stragglers
+        while len(new_gen) >= info.num_replicas and old_gen:
+            victim = old_gen.pop(0)
+            info.replicas.remove(victim)
+            self._drain_replica(info, victim)
+        # downscale: victims drain too — a downscale under load must
+        # not drop the requests already running on the victim
+        while len(new_gen) > info.num_replicas:
+            victim = new_gen.pop()
+            info.replicas.remove(victim)
+            self._drain_replica(info, victim)
 
         info.replica_set.set_replicas(info.replicas)
         info.state = ("HEALTHY"
                       if len(info.replicas) >= max(1, info.num_replicas)
                       else "DEPLOYING")
         self._push_routes(info)
+
+    # -- graceful drain ------------------------------------------------
+
+    def _drain_replica(self, info: DeploymentInfo, handle) -> None:
+        """Retire a replica without dropping requests: it is already
+        out of ``info.replicas`` — route tables stop sending it new
+        work NOW (proxy pushes ACKED, not fire-and-forget, so no stale
+        snapshot routes to it after the drain decision); a background
+        drainer waits (bounded) for its in-flight count to reach zero,
+        then kills it."""
+        info.replica_set.set_replicas(info.replicas)
+        self._push_routes(info)
+        with self._lock:
+            proxies = list(self._proxies)
+            self._draining[handle] = info.name
+        for proxy in proxies:
+            try:
+                ray_tpu.get(
+                    proxy.update_routes.remote(info.name,
+                                               info.replica_set),
+                    timeout=10)
+            except Exception:
+                pass      # dead proxy: nothing routes through it
+        t = threading.Thread(
+            target=self._drain_and_kill,
+            args=(handle, info.graceful_shutdown_timeout_s),
+            daemon=True, name="rtpu-serve-drain")
+        t.start()
+
+    def _drain_and_kill(self, handle, timeout_s: float) -> None:
+        from ray_tpu.exceptions import GetTimeoutError
+        deadline = time.monotonic() + timeout_s
+        # settle: a request assigned just before the route update (or a
+        # streaming call not yet visible in the replica's count) is
+        # still in flight toward the replica
+        time.sleep(0.3)
+        zeros = 0
+        while time.monotonic() < deadline:
+            try:
+                n = int(ray_tpu.get(handle.num_ongoing.remote(),
+                                    timeout=5))
+            except GetTimeoutError:
+                # event loop busy with a long request — still draining
+                zeros = 0
+                continue
+            except Exception:
+                break                      # replica already dead
+            zeros = zeros + 1 if n == 0 else 0
+            if zeros >= 2:
+                break
+            time.sleep(0.25)
+        self._kill_replicas([handle])
+        with self._lock:
+            self._draining.pop(handle, None)
 
     def _proxy_ongoing(self, name: str) -> int:
         """Aggregate in-flight counts from worker-hosted proxies: their
@@ -309,6 +410,7 @@ class ServeController:
                 info.replica_set.max_ongoing)
             # wait for construction so state flips once it's servable
             ray_tpu.get(handle.ping.remote(), timeout=120)
+            handle._serve_gen = info.generation
             return handle
         except Exception:
             # A reconcile tick racing runtime teardown is not an error

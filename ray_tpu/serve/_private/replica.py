@@ -43,6 +43,10 @@ class ReplicaActor:
         # Created lazily on the replica's event loop.
         self._max_ongoing = max_ongoing_requests
         self._admission = None
+        # True in-flight count (admission waiters included): the
+        # controller's graceful drain polls this until zero before a
+        # replica is killed (reference: graceful_shutdown_wait_loop_s).
+        self._ongoing = 0
 
     def _admission_sem(self):
         if self._admission is None and self._max_ongoing:
@@ -57,11 +61,16 @@ class ReplicaActor:
 
     async def handle_request(self, method: str, args: tuple, kwargs: dict,
                              model_id=None):
-        sem = self._admission_sem()
-        if sem is not None:
-            async with sem:
-                return await self._invoke(method, args, kwargs, model_id)
-        return await self._invoke(method, args, kwargs, model_id)
+        self._ongoing += 1
+        try:
+            sem = self._admission_sem()
+            if sem is not None:
+                async with sem:
+                    return await self._invoke(method, args, kwargs,
+                                              model_id)
+            return await self._invoke(method, args, kwargs, model_id)
+        finally:
+            self._ongoing -= 1
 
     async def _invoke(self, method: str, args: tuple, kwargs: dict,
                       model_id):
@@ -85,16 +94,20 @@ class ReplicaActor:
         single item). Items flow to the caller AS they are yielded —
         consumers read them before the producer finishes. A streaming
         request holds its admission slot for the whole generation."""
-        sem = self._admission_sem()
-        if sem is not None:
-            async with sem:
-                async for item in self._invoke_streaming(
-                        method, args, kwargs, model_id):
-                    yield item
-            return
-        async for item in self._invoke_streaming(method, args, kwargs,
-                                                 model_id):
-            yield item
+        self._ongoing += 1
+        try:
+            sem = self._admission_sem()
+            if sem is not None:
+                async with sem:
+                    async for item in self._invoke_streaming(
+                            method, args, kwargs, model_id):
+                        yield item
+                return
+            async for item in self._invoke_streaming(method, args, kwargs,
+                                                     model_id):
+                yield item
+        finally:
+            self._ongoing -= 1
 
     async def _invoke_streaming(self, method: str, args: tuple,
                                 kwargs: dict, model_id=None):
@@ -119,3 +132,6 @@ class ReplicaActor:
 
     def ping(self) -> str:
         return "pong"
+
+    def num_ongoing(self) -> int:
+        return self._ongoing

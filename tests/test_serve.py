@@ -453,3 +453,111 @@ def test_max_ongoing_requests_caps_replica_concurrency(serve_instance):
     ray_tpu.get(refs, timeout=60)
     peak = ray_tpu.get(handle.peak_seen.remote(), timeout=30)
     assert 1 <= peak <= 2, peak      # the cap held under 8 callers
+
+
+def test_rolling_redeploy_zero_dropped_requests(serve_instance):
+    """Redeploy under load: no request fails, both versions are
+    observed serving during the roll, and the roll converges to only
+    the new version (reference: DeploymentVersion rolling update)."""
+    import threading
+
+    @serve.deployment(num_replicas=2)
+    class V:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def __call__(self, i):
+            time.sleep(0.02)
+            return (self.tag, i)
+
+    handle = serve.run(V.bind("v1"), name="roll")
+    results, errors = [], []
+    stop = threading.Event()
+
+    def spam():
+        i = 0
+        while not stop.is_set():
+            try:
+                results.append(ray_tpu.get(handle.remote(i), timeout=60))
+            except Exception as e:   # noqa: BLE001
+                errors.append(e)
+            i += 1
+
+    threads = [threading.Thread(target=spam) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.5)
+        serve.run(V.options(num_replicas=2).bind("v2"), name="roll")
+        # roll completes: no old-generation replicas remain
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = serve.status()["roll"]
+            if (not st["updating"] and st["live_replicas"] == 2
+                    and st["draining_replicas"] == 0):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"roll never converged: {st}")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, f"dropped requests during roll: {errors[:3]}"
+    tags = {tag for tag, _ in results}
+    assert tags == {"v1", "v2"}, (
+        f"both versions should serve during the roll, saw {tags}")
+    # fresh post-roll traffic (threads stopped) must be all-v2
+    post = {ray_tpu.get(handle.remote(i), timeout=60)[0]
+            for i in range(6)}
+    assert post == {"v2"}, f"old version served after the roll: {post}"
+    serve.delete("roll")
+
+
+def test_downscale_drains_in_flight(serve_instance):
+    """Scaling 3 -> 1 under load: victims finish their in-flight
+    requests before dying — zero failures (reference: graceful
+    shutdown on replica removal)."""
+    import threading
+
+    @serve.deployment(num_replicas=3)
+    class Slow:
+        def __call__(self, i):
+            time.sleep(0.05)
+            return i
+
+    handle = serve.run(Slow.bind(), name="down")
+    results, errors = [], []
+    stop = threading.Event()
+
+    def spam():
+        i = 0
+        while not stop.is_set():
+            try:
+                results.append(ray_tpu.get(handle.remote(i), timeout=60))
+            except Exception as e:   # noqa: BLE001
+                errors.append(e)
+            i += 1
+
+    threads = [threading.Thread(target=spam) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.4)
+        serve.run(Slow.options(num_replicas=1).bind(), name="down")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            st = serve.status()["down"]
+            if st["live_replicas"] == 1 and st["draining_replicas"] == 0:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"downscale never converged: {st}")
+        time.sleep(0.3)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, f"dropped requests during downscale: {errors[:3]}"
+    assert len(results) > 20
+    serve.delete("down")
