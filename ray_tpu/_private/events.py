@@ -31,7 +31,13 @@ def _buffer() -> Deque:
 def record(task_id_hex: str, name: str, state: str,
            worker: str = "", extra: Optional[dict] = None) -> None:
     """Ring buffer (event_log_enabled) and JSONL export
-    (event_export_enabled) gate INDEPENDENTLY."""
+    (event_export_enabled) gate INDEPENDENTLY. Short-circuits before
+    building the record when both sinks are off — this runs per task
+    transition on the hot path."""
+    from ray_tpu._private import export
+    log_on = get_config().event_log_enabled
+    if not log_on and export._writer is None:
+        return
     rec = {
         "task_id": task_id_hex,
         "name": name,
@@ -40,9 +46,8 @@ def record(task_id_hex: str, name: str, state: str,
         "ts": time.time(),
         **(extra or {}),
     }
-    if get_config().event_log_enabled:
+    if log_on:
         _buffer().append(rec)
-    from ray_tpu._private import export
     export.emit("TASK", rec)
 
 

@@ -59,6 +59,9 @@ class ActorInfo:
     lifetime: Optional[str] = None
     node_id: Optional["NodeID"] = None
     method_names: Tuple[str, ...] = ()
+    # async actors accept ray_tpu.cancel on in-flight calls (asyncio
+    # cancellation); the owner consults this before routing a cancel
+    is_async: bool = False
 
     @property
     def detached(self) -> bool:

@@ -220,7 +220,8 @@ class NestedClient:
 
     def create_actor(self, fn_descriptor: FunctionDescriptor,
                      args: tuple, kwargs: dict, options: TaskOptions,
-                     class_name: str, method_names: tuple = ()):
+                     class_name: str, method_names: tuple = (),
+                     is_async: bool = False):
         from ray_tpu._private.ids import ActorID
         arg_descs, kwargs_keys = self._ser_args(args, kwargs)
         options_dict = {f: getattr(options, f)
@@ -230,7 +231,7 @@ class NestedClient:
         actor_id_b = self._client.call(
             "nested_create_actor", fid, self._fn_shipment(fid),
             class_name, arg_descs, kwargs_keys, options_dict,
-            tuple(method_names))
+            tuple(method_names), bool(is_async))
         return ActorID(actor_id_b)
 
     def submit_actor_task(self, actor_id, method_name: str, args: tuple,

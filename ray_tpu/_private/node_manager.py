@@ -202,6 +202,7 @@ class NodeManagerGroup:
         self._pip_envs = PipEnvManager(self._on_pip_env_requeue)
         self.pg_manager = None  # set by the owning Worker after init
         self._fail_task_cb = None  # (spec, exception) -> None; set by Worker
+        self._cancelled_check = None  # (TaskID) -> bool; set by Worker
         self._recover_object_cb = None  # (ObjectID) -> bool; set by Worker
         self._ensure_host_copy_cb = None  # (ObjectID) -> (name, size)|None
         self._stream_item_cb = None  # (TaskID, results); set by Worker
@@ -719,6 +720,25 @@ class NodeManagerGroup:
             entry = self._actor_workers.get(actor_id)
             return entry[1] if entry else None
 
+    def cancel_actor_call(self, actor_id: ActorID,
+                          task_id: TaskID) -> bool:
+        """Route an async-actor call cancellation to the actor's
+        worker (asyncio cancellation on its event loop)."""
+        worker = self.actor_worker(actor_id)
+        if worker is None:
+            return False
+        try:
+            if isinstance(worker, RemoteActorWorker):
+                worker.handle.client.call(
+                    "cancel_actor_task", actor_id.binary(),
+                    task_id.binary(), timeout=5)
+            else:
+                worker.send(("cancel_actor_task", actor_id.binary(),
+                             task_id.binary()))
+            return True
+        except Exception:
+            return False
+
     def actor_node(self, actor_id: ActorID) -> Optional[NodeID]:
         with self._lock:
             entry = self._actor_workers.get(actor_id)
@@ -1085,10 +1105,126 @@ class NodeManagerGroup:
                             self._infeasible.clear()
                 if self.pg_manager is not None:
                     self.pg_manager.try_schedule_pending()
-                self._schedule_once(batch_limit)
+                # Cap the batch at roughly what can place right now:
+                # at queue depth, re-scanning the ENTIRE backlog on
+                # every capacity change made each tick O(backlog) in
+                # the policy — the dominant cost of the normal-task
+                # path (tasks beyond free capacity just bounced back).
+                self._schedule_once(min(batch_limit,
+                                        self._free_slot_estimate()))
                 self._dispatch_all()
+                self._rescue_stalled_pipelines()
             except Exception:
                 logger.exception("scheduling loop error")
+
+    def cancel_pipelined(self, task_id: TaskID) -> bool:
+        """Cancel a task queued on a busy worker's pipe (lease
+        pipelining): it is in ``_running`` (so ``cancel_queued``
+        misses it) but not executing (so the targeted SIGINT would
+        miss too). A targeted steal pulls it back; the stolen-reply
+        handler sees the cancel flag and completes it as cancelled.
+        Returns False when the task is not in a pipelined queue
+        position (caller falls through to the interrupt path)."""
+        with self._lock:
+            rt = self._running.get(task_id)
+            if rt is None:
+                return False
+            worker = rt.worker
+            pipeq = getattr(worker, "pipeq", None)
+            if not pipeq or task_id not in pipeq \
+                    or pipeq[0] == task_id:
+                return False   # executing (head) or not pipe-queued
+        try:
+            worker.send(("steal", [task_id.binary()]))
+            return True
+        except Exception:
+            return False
+
+    # How long a pipelined task may sit queued behind a worker's
+    # non-completing head task before it is stolen back. Well above a
+    # healthy hot-path task (<1ms), well below a blocked parent's get.
+    PIPELINE_STALL_S = 0.15
+
+    def _rescue_stalled_pipelines(self) -> None:
+        """Steal queued tasks off workers whose head task stopped
+        making progress — the head may be BLOCKED on a nested child
+        that is itself queued behind it (the lease-pipelining
+        deadlock); stolen tasks reschedule anywhere."""
+        now = time.monotonic()
+        with self._lock:
+            raylets = list(self._raylets.values())
+        for raylet in raylets:
+            with raylet.worker_pool._lock:
+                workers = list(raylet.worker_pool._all.values())
+            for w in workers:
+                with self._lock:
+                    if (not w.alive or w.is_actor_worker
+                            or len(w.pipeq) <= 1 or w.steal_pending
+                            or now - w.last_activity
+                            < self.PIPELINE_STALL_S):
+                        continue
+                    victims = [t.binary() for t in list(w.pipeq)[1:]]
+                    w.steal_pending = True
+                try:
+                    w.send(("steal", victims))
+                except Exception:
+                    with self._lock:
+                        w.steal_pending = False
+
+    def _on_tasks_stolen(self, worker: BaseWorker,
+                         task_ids: List[bytes]) -> None:
+        """Worker returned still-queued pipelined payloads: free their
+        slots on that worker and put them back through scheduling."""
+        requeue: List[TaskSpec] = []
+        cancelled: List[TaskSpec] = []
+        freed = []
+        with self._lock:
+            worker.steal_pending = False
+            for tid_b in task_ids:
+                task_id = TaskID(tid_b)
+                rt = self._running.pop(task_id, None)
+                if worker.inflight > 0 and rt is not None:
+                    worker.inflight -= 1
+                try:
+                    worker.pipeq.remove(task_id)
+                except ValueError:
+                    pass
+                if rt is None:
+                    continue
+                freed.append((rt.node_id, rt.resources, rt.pg))
+                # a stolen task was already burned once by a stalled
+                # worker: park it for a FREE worker instead of
+                # re-gluing it to another busy pipe
+                rt.spec._pipeline_steals = 2
+                if (self._cancelled_check is not None
+                        and self._cancelled_check(task_id)):
+                    # cancelled while queued on the pipe: it must
+                    # NEVER run — complete it as cancelled instead of
+                    # rescheduling it
+                    cancelled.append(rt.spec)
+                else:
+                    requeue.append(rt.spec)
+        for node_id, resources, pg in freed:
+            self._free_allocation(node_id, resources, pg)
+        for spec in cancelled:
+            from ray_tpu.exceptions import TaskCancelledError
+            self._complete_task(spec.task_id, [], None,
+                                TaskCancelledError(
+                                    f"task {spec.repr_name()} was "
+                                    "cancelled"))
+        if requeue:
+            with self._lock:
+                self._to_schedule.extend(requeue)
+            self._wake.set()
+
+    def _free_slot_estimate(self) -> int:
+        """~How many queued tasks could place this tick: total free CPU
+        plus headroom so zero-CPU / custom-resource tasks and
+        infeasibility detection always make progress."""
+        free = 0.0
+        for _nid, node in self.cluster_resources.nodes():
+            free += max(0.0, node.available.get("CPU", 0.0))
+        return int(free) + 8
 
     def _free_allocation(self, node_id: NodeID, resources: Dict[str, float],
                          pg=None) -> None:
@@ -1269,6 +1405,43 @@ class NodeManagerGroup:
         self._wake.set()
 
     def _dispatch_node(self, raylet: Raylet) -> None:
+        # Per-round submit coalescing: payloads bound for the same
+        # worker leave in ONE ("exec_batch", ...) frame instead of a
+        # frame per task (the submit half of the batched normal-task
+        # wire path); replies still stream back one per task.
+        buffers: Dict[int, Tuple[BaseWorker, List[Tuple[TaskSpec, dict]]]] \
+            = {}
+        try:
+            self._dispatch_node_inner(raylet, buffers)
+        finally:
+            for entry in buffers.values():
+                self._flush_worker_buffer(raylet, entry)
+
+    def _flush_worker_buffer(self, raylet: Raylet, entry) -> None:
+        worker, items = entry
+        if not items:
+            return
+        try:
+            if len(items) == 1:
+                worker.send(("exec", items[0][1]))
+            else:
+                worker.send(("exec_batch", [p for _s, p in items]))
+        except Exception as e:   # worker pipe broken mid-flush
+            for spec, _p in items:
+                with self._lock:
+                    self._running.pop(spec.task_id, None)
+                    if worker.inflight > 0:
+                        worker.inflight -= 1
+                    try:
+                        worker.pipeq.remove(spec.task_id)
+                    except ValueError:
+                        pass
+                self._free_allocation(raylet.node_id, spec.resources,
+                                      self._spec_pg(spec))
+                self._complete_task(spec.task_id, [], None,
+                                    WorkerCrashedError(str(e)))
+
+    def _dispatch_node_inner(self, raylet: Raylet, buffers) -> None:
         while True:
             with self._lock:
                 if not raylet.dispatch_queue or not raylet.alive:
@@ -1298,13 +1471,30 @@ class NodeManagerGroup:
             worker = raylet.worker_pool.pop_worker(
                 spec.resources, dedicated, env_tag=env_tag,
                 python_exe=python_exe)
+            fresh = worker is not None
             if worker is None:
-                with self._lock:
-                    raylet.dispatch_queue.appendleft(spec)
-                return
-            err = self._send_task(raylet, worker, spec)
+                # Lease pipelining: rather than stall until a done→
+                # push→pop round trip frees a pool slot, queue a plain
+                # normal task on a busy worker's pipe (bounded depth) —
+                # the submit half of the batched normal-task wire path.
+                if (spec.task_type == TaskType.NORMAL_TASK
+                        and env_tag is None and python_exe is None
+                        and getattr(spec, "_pipeline_steals", 0) < 2
+                        and raylet.worker_pool.substrate_for(
+                            spec.resources) == "process"):
+                    worker = raylet.worker_pool.pipeline_candidate()
+                if worker is None:
+                    with self._lock:
+                        raylet.dispatch_queue.appendleft(spec)
+                    return
+            err = self._send_task(raylet, worker, spec, buffers=buffers)
+            entry = buffers.get(id(worker))
+            if (entry is not None and len(entry[1])
+                    >= raylet.worker_pool.PIPELINE_DEPTH):
+                self._flush_worker_buffer(raylet, buffers.pop(id(worker)))
             if err is not None:
-                raylet.worker_pool.push_worker(worker)
+                if fresh:
+                    raylet.worker_pool.push_worker(worker)
                 self._free_allocation(raylet.node_id, spec.resources,
                                       self._spec_pg(spec))
                 if isinstance(err, _DependencyError):
@@ -1328,7 +1518,8 @@ class NodeManagerGroup:
                     self._complete_task(spec.task_id, [], None, err)
 
     def _send_task(self, raylet: Raylet, worker: BaseWorker,
-                   spec: TaskSpec) -> Optional[BaseException]:
+                   spec: TaskSpec,
+                   buffers=None) -> Optional[BaseException]:
         """Build the payload (resolving args from the owner's stores) and
         ship it. Returns an error to fail the task without executing."""
         arg_descs = []
@@ -1409,14 +1600,30 @@ class NodeManagerGroup:
                 self._running[spec.task_id] = RunningTask(
                     spec, raylet.node_id, worker, dict(spec.resources),
                     pg=self._spec_pg(spec))
-            worker.send(("exec" if payload["type"] == "exec"
-                         else "create_actor", payload))
+                if payload["type"] == "exec":
+                    worker.inflight += 1
+                    worker.pipeq.append(spec.task_id)
+                    worker.last_activity = time.monotonic()
+            if buffers is not None and payload["type"] == "exec":
+                entry = buffers.get(id(worker))
+                if entry is None:
+                    entry = buffers[id(worker)] = (worker, [])
+                entry[1].append((spec, payload))
+            else:
+                worker.send(("exec" if payload["type"] == "exec"
+                             else "create_actor", payload))
             from ray_tpu._private import events
             events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
                           worker=worker.worker_id.hex()[:8])
         except Exception as e:  # worker pipe broken
             with self._lock:
                 self._running.pop(spec.task_id, None)
+                if payload["type"] == "exec" and worker.inflight > 0:
+                    worker.inflight -= 1
+                    try:
+                        worker.pipeq.remove(spec.task_id)
+                    except ValueError:
+                        pass
             return WorkerCrashedError(str(e))
         return None
 
@@ -1448,6 +1655,9 @@ class NodeManagerGroup:
             if evt is not None:
                 evt.set()
             return
+        if op == "stolen":
+            self._on_tasks_stolen(worker, reply[1])
+            return
         if op == "stacks":
             from ray_tpu._private.profiling import deliver_stack_reply
             deliver_stack_reply(worker, reply[1])
@@ -1463,7 +1673,18 @@ class NodeManagerGroup:
             if not worker.is_actor_worker:
                 with self._lock:
                     raylet = self._raylets.get(rt.node_id)
-                if raylet is not None:
+                    if worker.inflight > 0:
+                        worker.inflight -= 1
+                    try:
+                        worker.pipeq.remove(task_id)
+                    except ValueError:
+                        pass
+                    worker.last_activity = time.monotonic()
+                    worker.steal_pending = False
+                    idle = worker.inflight == 0
+                if raylet is not None and idle:
+                    # pipelined tasks may still be queued on the pipe;
+                    # the worker rejoins the pool only when drained
                     raylet.worker_pool.push_worker(worker)
                 self._free_allocation(rt.node_id, rt.resources, rt.pg)
                 self._wake.set()

@@ -120,6 +120,8 @@ class RayletServer:
         self.server.register("submit", self._handle_submit)
         self.server.register("submit_batch", self._handle_submit_batch)
         self.server.register("kill_actor", self._handle_kill_actor)
+        self.server.register("cancel_actor_task",
+                             self._handle_cancel_actor_task)
         self.server.register("cancel_task", self._handle_cancel_task)
         self.server.register("adjust_pool", self._handle_adjust_pool)
         self.server.register("shutdown", lambda ctx: self._request_shutdown())
@@ -324,6 +326,19 @@ class RayletServer:
     def _handle_kill_actor(self, ctx: ConnectionContext,
                            actor_id: bytes) -> None:
         self._reap_actor(actor_id, "killed")
+
+    def _handle_cancel_actor_task(self, ctx: ConnectionContext,
+                                  actor_id: bytes,
+                                  task_id: bytes) -> None:
+        """Forward an async-actor call cancellation to the actor's
+        worker pipe (handled at the worker's intake thread)."""
+        with self._lock:
+            worker = self._actor_workers.get(actor_id)
+        if worker is not None:
+            try:
+                worker.send(("cancel_actor_task", actor_id, task_id))
+            except Exception:
+                pass
 
     def _handle_dump_stacks(self, ctx) -> dict:
         """On-demand host profiling (reference: the dashboard

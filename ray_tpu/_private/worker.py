@@ -219,6 +219,7 @@ class Worker:
         self.node_group.pg_manager = self.pg_manager
         self.node_group._fail_task_cb = self._fail_task
         self.node_group._recover_object_cb = self._recover_object
+        self.node_group._cancelled_check = self._task_cancelled
         self.node_group._ensure_host_copy_cb = self._ensure_host_copy
         self.node_group._stream_item_cb = self._on_stream_item
         self._pg_ready_refs: Dict[Any, ObjectID] = {}
@@ -721,7 +722,8 @@ class Worker:
 
     def _nested_create_actor(self, ctx, fid: bytes, fn_blob,
                              class_name: str, arg_descs, kwargs_keys,
-                             options_dict, method_names=()) -> bytes:
+                             options_dict, method_names=(),
+                             is_async: bool = False) -> bytes:
         if fn_blob is not None:
             with self._functions_lock:
                 self._functions.setdefault(fid, fn_blob)
@@ -731,7 +733,8 @@ class Worker:
         actor_id = self.create_actor(descriptor, args, kwargs,
                                      TaskOptions(**options_dict),
                                      class_name,
-                                     method_names=tuple(method_names))
+                                     method_names=tuple(method_names),
+                                     is_async=bool(is_async))
         return actor_id.binary()
 
     def _nested_actor_task(self, ctx, actor_id_b: bytes, method: str,
@@ -1263,7 +1266,8 @@ class Worker:
     def create_actor(self, fn_descriptor: FunctionDescriptor, args: tuple,
                      kwargs: dict, options: TaskOptions,
                      class_name: str,
-                     method_names: tuple = ()) -> ActorID:
+                     method_names: tuple = (),
+                     is_async: bool = False) -> ActorID:
         actor_id = ActorID.of(self.job_id)
         task_id = self.next_task_id()
         spec_args: List[TaskArg] = []
@@ -1318,7 +1322,8 @@ class Worker:
             max_restarts=max_restarts,
             creation_spec=spec, class_name=class_name,
             lifetime=options.lifetime,
-            method_names=tuple(method_names))
+            method_names=tuple(method_names),
+            is_async=is_async)
         self.gcs.register_actor(info)
         from ray_tpu._private import export
         export.emit("ACTOR", {"actor_id": actor_id.hex(),
@@ -1591,6 +1596,10 @@ class Worker:
             payload["stream_skip"] = spec.stream_skip
         return payload, None
 
+    def _task_cancelled(self, task_id: TaskID) -> bool:
+        rec = self.task_manager.get_record(task_id)
+        return rec is not None and rec.cancelled
+
     def _on_actor_death(self, actor_id: ActorID) -> None:
         from ray_tpu._private import export
         with self._actor_lock:
@@ -1736,21 +1745,37 @@ class Worker:
             sweep_orphan_segments(self.session)
 
     def cancel_task(self, ref, force: bool = False) -> None:
-        """Cancel a NORMAL task (reference ``ray.cancel`` semantics,
-        best-effort): a queued task never runs; a running task gets
-        KeyboardInterrupt (or its worker killed, with ``force``); a
-        finished task keeps its result. Consumers of a cancelled
-        task's refs see TaskCancelledError. Actor calls are not
-        cancellable (TypeError, like the reference)."""
+        """Cancel a NORMAL task or an ASYNC-actor call (reference
+        ``ray.cancel`` semantics, best-effort): a queued normal task
+        never runs; a running one gets KeyboardInterrupt (or its
+        worker killed, with ``force``); an async-actor call is
+        cancelled on the actor's event loop (queued calls immediately,
+        running coroutines at their next await). A finished task keeps
+        its result. Consumers of a cancelled task's refs see
+        TaskCancelledError. SYNC actor calls are not cancellable
+        (TypeError, like the reference)."""
         from ray_tpu.exceptions import TaskCancelledError
         task_id = ref.id().task_id()
         rec = self.task_manager.get_record(task_id)
         if rec is None:
             return                       # unknown/already released
+        if rec.spec.task_type == TaskType.ACTOR_TASK:
+            actor_id = rec.spec.actor_id
+            info = self.gcs.get_actor_info(actor_id)
+            if info is None or not getattr(info, "is_async", False):
+                raise TypeError(
+                    "ray_tpu.cancel() on actor calls is supported for "
+                    "ASYNC actors only (asyncio cancellation); sync "
+                    "actor calls cannot be interrupted")
+            status = self.task_manager.mark_cancelled(task_id)
+            if status in ("finished", "failed"):
+                return
+            self.node_group.cancel_actor_call(actor_id, task_id)
+            return
         if rec.spec.task_type != TaskType.NORMAL_TASK:
             raise TypeError(
-                "ray_tpu.cancel() supports normal tasks only; actor "
-                "calls cannot be cancelled")
+                "ray_tpu.cancel() supports normal tasks and async "
+                "actor calls only")
         status = self.task_manager.mark_cancelled(task_id)
         if status in ("finished", "failed"):
             return                       # too late: result/error stands
@@ -1761,6 +1786,12 @@ class Worker:
                 TaskCancelledError(
                     f"task {rec.spec.repr_name()} was cancelled before "
                     "it started"))
+            return
+        if self.node_group.cancel_pipelined(task_id):
+            # queued on a busy worker's pipe: a targeted steal pulls
+            # it back and the stolen-reply handler (which re-checks the
+            # cancel flag) completes it as cancelled — the SIGINT
+            # path would have matched the wrong (executing) task
             return
         # running (or in a dispatch race): interrupt best-effort; the
         # resulting failure completes through the cancelled path

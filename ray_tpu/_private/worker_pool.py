@@ -21,6 +21,7 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from ray_tpu._private.config import get_config
@@ -38,6 +39,14 @@ class BaseWorker:
         self.alive = True
         self.ready = False
         self.last_idle = time.monotonic()
+        # Normal tasks queued on this worker's pipe (lease pipelining):
+        # the worker returns to the idle pool only at zero. ``pipeq``
+        # is their send order (head = executing); ``last_activity``
+        # and ``steal_pending`` drive the stalled-pipeline rescue.
+        self.inflight = 0
+        self.pipeq: "deque" = deque()
+        self.last_activity = time.monotonic()
+        self.steal_pending = False
 
     def send(self, msg: tuple) -> None:
         raise NotImplementedError
@@ -161,6 +170,8 @@ class InProcessWorker(BaseWorker):
                 self.env.dag_stages[msg[1]] = msg[2]
             elif op == "actor_tmpl":
                 self.env.actor_templates[msg[1]] = msg[2]
+            elif op == "cancel_actor_task":
+                self.env.cancel_actor_task(msg[1], msg[2])
             elif op in ("exec", "create_actor", "exec_actor",
                         "exec_actor_batch"):
                 try:
@@ -353,6 +364,26 @@ class WorkerPool:
                 w.kill()
             if not tagged:
                 del self._idle_tagged[tag]
+
+    PIPELINE_DEPTH = 8   # max queued normal tasks per leased worker
+
+    def pipeline_candidate(self) -> Optional[BaseWorker]:
+        """A busy generic process worker with pipe headroom: normal
+        tasks can queue on its connection instead of waiting a full
+        done→push→pop round trip for a pool slot (reference:
+        NormalTaskSubmitter's lease pipelining). Returns the
+        least-loaded candidate, or None."""
+        best = None
+        best_infl = self.PIPELINE_DEPTH
+        with self._lock:
+            for w in self._all.values():
+                if (w.alive and w.ready and w.leased
+                        and w.kind == "process"
+                        and not w.is_actor_worker
+                        and getattr(w, "env_tag", None) is None
+                        and 0 < w.inflight < best_infl):
+                    best, best_infl = w, w.inflight
+        return best
 
     def push_worker(self, worker: BaseWorker) -> None:
         with self._lock:

@@ -184,11 +184,13 @@ class ExecutionEnv:
                     pool.submit(
                         lambda p=p: send(self.execute(p, emit=send)))
                 return
-            if len(payloads) == 1:
-                send(self.execute(payloads[0], emit=send))
-                return
-            replies = [self.execute(p, emit=send) for p in payloads]
-            send(("batch", replies))
+            # One reply per call AS PRODUCED — coalescing the whole
+            # batch into one frame would withhold the first call's
+            # result until the last finishes, a pipelined-consumption
+            # latency cliff for slow methods (reply batching is only
+            # a win on the async loop, which flushes incrementally).
+            for p in payloads:
+                send(self.execute(p, emit=send))
             return
         payload = self.merge_stage(self.merge_actor(body))
         if op == "exec_actor":
@@ -204,6 +206,14 @@ class ExecutionEnv:
                                                                 emit=send)))
                 return
         send(self.execute(payload, emit=send))
+
+    def cancel_actor_task(self, actor_id: bytes, task_id: bytes) -> None:
+        """Cancel an in-flight ASYNC actor call; a no-op for sync
+        actors (their calls are not interruptible — the public API
+        refuses them before it gets here)."""
+        aloop = self._aloops.get(actor_id)
+        if aloop is not None:
+            aloop.cancel(task_id)
 
     def _pool_for(self, actor_id: bytes, conc: int):
         # one pool PER actor sized to its declared cap — max_concurrency
@@ -451,6 +461,16 @@ class ExecutionEnv:
                 return ("actor_ready", payload["actor_id"], blob)
             return ("done", task_id, [], blob,
                     {"exec_ms": 1e3 * (_time.perf_counter() - t_start)})
+        finally:
+            # Clear identity the moment user code is done — BEFORE the
+            # reply is sent — so a targeted cancel SIGINT landing in
+            # the send window can't match this finished task and kill
+            # the worker. Guarded: pool threads running other calls
+            # must not have their fallback clobbered.
+            if getattr(_CURRENT_TASK, "task_id", b"") == task_id:
+                _CURRENT_TASK.task_id = b""
+            if _TASK_FALLBACK.get("task_id") == task_id:
+                _TASK_FALLBACK["task_id"] = b""
 
     async def execute_async(self, payload: dict, emit=None) -> tuple:
         """Async-actor variant of ``execute``: runs ON the actor's event
@@ -672,6 +692,7 @@ class _AsyncActorLoop:
         self._concurrency = concurrency
         self.loop = asyncio.new_event_loop()
         self._sem: Optional["asyncio.Semaphore"] = None
+        self._inflight: Dict[bytes, "asyncio.Task"] = {}
         self._buf: list = []
         self._flush_scheduled = False
         self._send: Optional[Callable[[tuple], None]] = None
@@ -721,12 +742,35 @@ class _AsyncActorLoop:
 
     def _start_batch(self, payloads: List[dict]) -> None:
         for p in payloads:
-            self.loop.create_task(self._call(p))
+            task = self.loop.create_task(self._call(p))
+            self._inflight[p["task_id"]] = task
+
+    def cancel(self, task_id: bytes) -> None:
+        """Cancel one in-flight call via asyncio cancellation
+        (reference: ray.cancel on async-actor tasks). Queued calls
+        (semaphore waiters) cancel immediately; a running coroutine
+        gets CancelledError at its next await point. Thread-safe."""
+        def _do():
+            task = self._inflight.get(task_id)
+            if task is not None:
+                task.cancel()
+        try:
+            self.loop.call_soon_threadsafe(_do)
+        except RuntimeError:
+            pass   # loop closed: actor already dying
 
     async def _call(self, payload: dict) -> None:
-        async with self._sem:
-            reply = await self._env.execute_async(payload,
-                                                  emit=self._emit)
+        try:
+            async with self._sem:
+                reply = await self._env.execute_async(payload,
+                                                      emit=self._emit)
+        except BaseException as e:   # noqa: BLE001 — incl. CancelledError
+            err = TaskError(e, payload.get("name", "?"),
+                            f"{type(e).__name__}: {e}")
+            reply = ("done", payload["task_id"], [],
+                     self._env.serde.serialize(err).to_bytes(), None)
+        finally:
+            self._inflight.pop(payload["task_id"], None)
         self._buf.append(reply)
         if not self._flush_scheduled:
             self._flush_scheduled = True
@@ -767,6 +811,21 @@ def worker_main(conn, session: str, max_inline_bytes: int,
     """
     if env_vars:
         os.environ.update(env_vars)
+
+    if os.environ.get("RTPU_WORKER_PROFILE"):
+        # Debug: cProfile this worker's whole loop, dumped at exit —
+        # the worker-side complement of `ray_tpu stack` sampling.
+        import atexit
+        import cProfile
+        _prof = cProfile.Profile()
+        _prof.enable()
+
+        def _dump_profile():
+            _prof.disable()
+            path = (f"{os.environ['RTPU_WORKER_PROFILE']}."
+                    f"{os.getpid()}.pstats")
+            _prof.dump_stats(path)
+        atexit.register(_dump_profile)
 
     from ray_tpu._private import worker_core
     worker_core.configure(session, max_inline_bytes)
@@ -820,7 +879,12 @@ def worker_main(conn, session: str, max_inline_bytes: int,
         except OSError:
             pass
         if target:
-            current = _TASK_FALLBACK.get("task_id") or b""
+            # The handler runs on the MAIN thread, so its thread-local
+            # names the task the signal would actually interrupt; the
+            # process-wide fallback (which pool threads overwrite)
+            # is only consulted when the local is unset.
+            current = (getattr(_CURRENT_TASK, "task_id", b"")
+                       or _TASK_FALLBACK.get("task_id") or b"")
             cur_hex = (current.hex() if isinstance(current, bytes)
                        else str(current))
             if target != cur_hex:
@@ -833,16 +897,80 @@ def worker_main(conn, session: str, max_inline_bytes: int,
     except (ValueError, OSError):
         pass
 
-    try:
+    # Inbound frames flow through an intake thread into ``inbox`` so
+    # the owner can STEAL back pipelined tasks that are queued behind a
+    # long/blocked task (lease pipelining would otherwise deadlock a
+    # parent blocked on a child queued on its own pipe). The intake
+    # thread answers ("steal", ids) immediately — removing still-queued
+    # exec payloads — even while the main loop is deep in user code.
+    from collections import deque as _deque
+    inbox: "_deque" = _deque()
+    inbox_lock = threading.Lock()
+    inbox_evt = threading.Event()
+    conn_closed = [False]
+
+    def _intake() -> None:
         while True:
             try:
                 msg = conn.recv()
             except (EOFError, OSError):
-                break
-            except KeyboardInterrupt:
-                # A cancellation SIGINT that raced the task's own
-                # completion lands here while idle: the cancel was for
-                # work that already finished — keep serving.
+                conn_closed[0] = True
+                inbox_evt.set()
+                return
+            op0 = msg[0]
+            if op0 == "steal":
+                wanted = set(msg[1])
+                taken = []
+                with inbox_lock:
+                    kept = []
+                    for m in inbox:
+                        if m[0] == "exec" and m[1]["task_id"] in wanted:
+                            taken.append(m[1]["task_id"])
+                        else:
+                            kept.append(m)
+                    inbox.clear()
+                    inbox.extend(kept)
+                try:
+                    send(("stolen", taken))
+                except Exception:
+                    return
+                continue
+            if op0 == "cancel_actor_task":
+                # Async-actor call cancellation: handled at intake (the
+                # main loop may be busy) — the actor's event loop
+                # cancels the asyncio task at its next await point.
+                try:
+                    env.cancel_actor_task(msg[1], msg[2])
+                except Exception:
+                    pass
+                continue
+            if op0 == "exec_batch":
+                # flatten so individual queued tasks stay stealable
+                with inbox_lock:
+                    inbox.extend(("exec", p) for p in msg[1])
+            else:
+                with inbox_lock:
+                    inbox.append(msg)
+            inbox_evt.set()
+
+    threading.Thread(target=_intake, daemon=True,
+                     name="rtpu-worker-intake").start()
+
+    try:
+        while True:
+            with inbox_lock:
+                msg = inbox.popleft() if inbox else None
+            if msg is None:
+                if conn_closed[0]:
+                    break
+                try:
+                    inbox_evt.wait(timeout=1.0)
+                    inbox_evt.clear()
+                except KeyboardInterrupt:
+                    # A cancellation SIGINT that raced the task's own
+                    # completion lands here while idle: the cancel was
+                    # for work that already finished — keep serving.
+                    pass
                 continue
             op = msg[0]
             if op == "shutdown":
@@ -857,6 +985,13 @@ def worker_main(conn, session: str, max_inline_bytes: int,
                         "exec_actor_batch"):
                 try:
                     env.dispatch(op, msg[1], send)
+                except KeyboardInterrupt:
+                    # A cancel SIGINT that slipped past execute()'s
+                    # handlers (landed between user code finishing and
+                    # the reply send): the target already completed —
+                    # keep serving instead of killing the worker and
+                    # every other in-flight task on it.
+                    pass
                 finally:
                     if op == "exec":
                         # the cancellation-SIGINT guard compares

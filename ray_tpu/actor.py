@@ -164,7 +164,8 @@ class ActorClass:
         actor_id = w.create_actor(
             self._get_descriptor(), args, kwargs, opts,
             class_name=self._cls.__name__,
-            method_names=self._method_names())
+            method_names=self._method_names(),
+            is_async=self._has_async_methods())
         return ActorHandle(actor_id, self._cls.__name__,
                            self._method_names())
 

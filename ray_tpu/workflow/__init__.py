@@ -25,8 +25,12 @@ needs nothing but the workflow id. Beyond the static DAG:
 Storage layout ({storage}/{workflow_id}/):
   dag.pkl               the cloudpickled (dag, args)
   status                RUNNING | SUCCEEDED | FAILED
-  step_<k>.pkl          pickled ("v", value) — step k's durable value
-                        or ("cont",) — step k returned a continuation
+  step_<k>.pkl          pickled (FORMAT, "v", value) — step k's
+                        durable value — or (FORMAT, "cont",) — step k
+                        returned a continuation; FORMAT tags the record
+                        layout so a resume against records from an
+                        incompatible ray_tpu version fails with a clear
+                        error instead of silently misreading
   step_<k>_cont/        the continuation's own workflow directory
 """
 
@@ -40,6 +44,9 @@ import cloudpickle
 
 import ray_tpu
 from ray_tpu.dag import CompiledDAG, DAGNode, FunctionNode, InputNode
+
+# Durable step-record layout version (see module docstring).
+_STEP_FORMAT = "rtpu-step-v2"
 
 __all__ = ["run", "resume", "list_all", "delete", "get_status",
            "options", "continuation", "Continuation", "WorkflowError"]
@@ -193,11 +200,11 @@ def _execute(dag: DAGNode, inputs: tuple, d: str) -> Any:
             # the marker persists BEFORE the sub-workflow runs: resume
             # finds it and re-enters the continuation, never re-running
             # the step that produced it
-            _write(step_path, pickle.dumps(("cont",)))
+            _write(step_path, pickle.dumps((_STEP_FORMAT, "cont")))
             value = run_continuation(node, payload.dag, cont_dir)
         else:
             value = payload
-            _write(step_path, pickle.dumps(("v", value)))
+            _write(step_path, pickle.dumps((_STEP_FORMAT, "v", value)))
         values[id(node)] = value
         done.add(k)
 
@@ -212,8 +219,15 @@ def _execute(dag: DAGNode, inputs: tuple, d: str) -> Any:
             continue
         with open(step_path, "rb") as f:
             record = pickle.load(f)
-        if record[0] == "v":
-            values[id(node)] = record[1]
+        if (not isinstance(record, tuple) or not record
+                or record[0] != _STEP_FORMAT):
+            raise RuntimeError(
+                f"incompatible workflow storage format in {step_path}: "
+                f"expected records tagged {_STEP_FORMAT!r} (this "
+                f"workflow was persisted by a different ray_tpu "
+                f"version; re-run it from scratch)")
+        if record[1] == "v":
+            values[id(node)] = record[2]
         else:                       # persisted continuation
             cont_dir = os.path.join(d, f"step_{k}_cont")
             res_path = os.path.join(cont_dir, "result.pkl")

@@ -127,3 +127,74 @@ def test_cancel_on_remote_raylet(ray_start_cluster):
     with pytest.raises(TaskCancelledError):
         ray_tpu.get(ref, timeout=30)
     assert time.perf_counter() - t0 < 25
+
+
+def test_cancel_async_actor_call(rt):
+    """ray.cancel on ASYNC-actor calls: a running coroutine is
+    cancelled at its next await; queued calls are cancelled before
+    they start; the actor itself stays healthy (reference: asyncio
+    cancellation for async-actor tasks)."""
+    @ray_tpu.remote(max_concurrency=1)
+    class Async:
+        def __init__(self):
+            self.progress = 0
+
+        async def slow(self):
+            import asyncio
+            for _ in range(200):
+                await asyncio.sleep(0.1)
+                self.progress += 1
+            return "finished"
+
+        async def quick(self):
+            return self.progress
+
+    a = Async.remote()
+    assert ray_tpu.get(a.quick.remote(), timeout=60) == 0
+
+    running = a.slow.remote()
+    queued = a.slow.remote()     # waits on the concurrency semaphore
+    time.sleep(1.0)              # first slow() is mid-coroutine
+    ray_tpu.cancel(queued)
+    ray_tpu.cancel(running)
+    t0 = time.perf_counter()
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(running, timeout=30)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued, timeout=30)
+    assert time.perf_counter() - t0 < 20   # not the 20s run time
+    # the actor survives and serves new calls
+    assert ray_tpu.get(a.quick.remote(), timeout=30) >= 0
+
+
+def test_cancel_pipelined_task_never_runs(rt):
+    """A task queued on a busy worker's pipe (lease pipelining) is
+    cancellable: the owner steals it back and completes it cancelled —
+    it must not run after the head task finishes (the pre-pipelining
+    guarantee for queued tasks)."""
+    import tempfile
+    marker = tempfile.mktemp(prefix="rtpu_cancel_pipe_")
+
+    @ray_tpu.remote
+    def blocker():
+        time.sleep(4)
+        return "done"
+
+    @ray_tpu.remote
+    def touch(path):
+        with open(path, "w") as f:
+            f.write("ran")
+        return "ran"
+
+    # saturate the pool so `touch` pipelines behind a blocker
+    blockers = [blocker.remote() for _ in range(8)]
+    time.sleep(1.0)
+    ref = touch.remote(marker)
+    time.sleep(0.3)            # let it dispatch onto a busy pipe
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert ray_tpu.get(blockers, timeout=60) == ["done"] * 8
+    time.sleep(0.5)
+    import os
+    assert not os.path.exists(marker), "cancelled pipelined task ran"
