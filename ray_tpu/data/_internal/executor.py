@@ -20,6 +20,7 @@ Key properties preserved:
 from __future__ import annotations
 
 import logging
+import math
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -623,10 +624,30 @@ class StreamingExecutor:
                 pass  # partitions sorted ascending then reversed at concat
         split = _split_fn_factory(kind, n_out, kwargs)
         reduce = _reduce_fn_factory(kind, kwargs)
-        split_remote = ray_tpu.remote(split)
+
+        # Two-level shuffle (reference: push-based/multi-stage shuffle):
+        # one split task per block × n_out partitions is N² intermediate
+        # objects — ownership tables and the scheduler drown before the
+        # data does (1k blocks -> 1M refs). Grouping ~√N blocks per
+        # combiner bounds intermediates to G·n_out = O(N^1.5) and every
+        # reduce's fan-in to G = O(√N).
+        def combine(*blks):
+            partss = [split(b) for b in blks]
+            if len(partss) == 1:
+                return partss[0]
+            if n_out == 1:
+                return blib.concat_blocks(list(partss))
+            return tuple(
+                blib.concat_blocks([p[i] for p in partss])
+                for i in range(n_out))
+
+        group_size = max(1, int(math.ceil(math.sqrt(len(refs)))))
+        groups = [refs[i:i + group_size]
+                  for i in range(0, len(refs), group_size)]
+        combine_remote = ray_tpu.remote(combine)
         parts: List[List] = []
-        for r in refs:
-            out = split_remote.options(num_returns=n_out).remote(r)
+        for grp in groups:
+            out = combine_remote.options(num_returns=n_out).remote(*grp)
             if n_out == 1:
                 out = [out]
             parts.append(out)
