@@ -39,7 +39,8 @@ _PAGE = """<!doctype html>
 <nav id="nav"></nav><div id="content">summary loading…</div>
 <p class="mut"><a href="/metrics">/metrics</a> (Prometheus)</p>
 <script>
-const TABS = ["summary","nodes","actors","tasks","objects","workers"];
+const TABS = ["summary","nodes","actors","tasks","objects","workers",
+              "timeline"];
 let tab = location.hash.slice(1) || "summary";
 const nav = document.getElementById("nav");
 TABS.forEach(t => {
@@ -67,6 +68,35 @@ function table(rows){
     h += "<tr>" + cols.map(c=>`<td>${cell(r[c])}</td>`).join("") + "</tr>";
   return h + "</table>";
 }
+function timeline(evts){
+  if (!evts || !evts.length) return "<p>no finished tasks yet</p>";
+  evts = evts.slice(-400);
+  const t0 = Math.min(...evts.map(e=>e.ts));
+  const t1 = Math.max(...evts.map(e=>e.ts+e.dur));
+  const span = Math.max(t1 - t0, 1);
+  const lanes = [...new Set(evts.map(e=>e.tid))].sort((a,b)=>a-b);
+  const W = 900, H = 18, PAD = 70;
+  let s = `<p class="mut">${evts.length} task spans · `
+    + `${(span/1e6).toFixed(2)}s window · lane = worker</p>`
+    + `<svg width="${W+PAD+10}" height="${(lanes.length)*(H+4)+24}" `
+    + `style="background:#fff;border:1px solid #ccc">`;
+  lanes.forEach((lane,i) => {
+    const y = i*(H+4)+4;
+    s += `<text x="2" y="${y+13}" font-size="11" fill="#777">`
+      + `w${esc(String(lane))}</text>`;
+  });
+  for (const e of evts){
+    const i = lanes.indexOf(e.tid);
+    const x = PAD + (e.ts - t0)/span*W;
+    const w = Math.max(e.dur/span*W, 1.5);
+    const y = i*(H+4)+4;
+    const ms = (e.dur/1e3).toFixed(1);
+    s += `<rect x="${x}" y="${y}" width="${w}" height="${H}" `
+      + `fill="#2a6df4" opacity="0.75">`
+      + `<title>${esc(e.name)} · ${ms}ms</title></rect>`;
+  }
+  return s + "</svg>";
+}
 async function render(){
   TABS.forEach(t => document.getElementById("tab-"+t)
     .classList.toggle("on", t === tab));
@@ -74,7 +104,8 @@ async function render(){
     const data = await (await fetch("/api/" + tab)).json();
     document.getElementById("content").innerHTML =
       tab === "summary" ? "<pre>" +
-        JSON.stringify(data, null, 2) + "</pre>" : table(data);
+        JSON.stringify(data, null, 2) + "</pre>" :
+      tab === "timeline" ? timeline(data) : table(data);
     document.getElementById("refreshed").textContent =
       "· " + new Date().toLocaleTimeString();
   } catch (e) {
@@ -111,6 +142,14 @@ class Dashboard:
                     elif path == "/api/summary":
                         self._send(json.dumps(state.summary()).encode(),
                                    "application/json")
+                    elif path == "/api/timeline":
+                        # Chrome-trace ("catapult") spans from the task
+                        # event ring — the `ray timeline` surface; the
+                        # UI's timeline tab renders the same payload.
+                        from ray_tpu._private import events
+                        self._send(
+                            json.dumps(events.get_task_events()).encode(),
+                            "application/json")
                     elif path.startswith("/api/"):
                         kind = path[len("/api/"):]
                         fn = getattr(state, f"list_{kind}", None)

@@ -114,6 +114,106 @@ def _cmd_status(args) -> int:
     return 0
 
 
+def _render_table(rows, columns) -> None:
+    """Fixed-width table over selected columns of state-API rows."""
+    if not rows:
+        print("(none)")
+        return
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in columns}
+    print("  ".join(c.upper().ljust(widths[c]) for c in columns))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c])
+                        for c in columns))
+
+
+_LIST_COLUMNS = {
+    "nodes": ["node_id", "alive", "is_head", "remote",
+              "resources_total"],
+    "actors": ["actor_id", "class_name", "state", "name",
+               "num_restarts"],
+    "tasks": ["task_id", "name", "status", "attempt", "resources"],
+    "objects": ["object_id", "location", "reference_counts"],
+    "workers": ["node_id", "kind"],
+}
+
+
+def _fetch_state(args, kind: str):
+    """State rows: from a running driver's dashboard API
+    (``--dashboard``, the live source covering every kind), else from
+    the GCS (``--address``: nodes/actors only — tasks and objects are
+    driver-owned state the GCS does not hold)."""
+    import json as _json
+    import urllib.request
+    if getattr(args, "dashboard", ""):
+        url = f"http://{args.dashboard}/api/{kind}"
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return _json.loads(r.read().decode())
+    if not getattr(args, "address", ""):
+        raise SystemExit("pass --dashboard HOST:PORT (live driver) or "
+                         "--address GCS_HOST:PORT")
+    if kind not in ("nodes", "actors"):
+        raise SystemExit(
+            f"'{kind}' is driver-owned state: reach a live driver with "
+            f"--dashboard HOST:PORT (the GCS only has nodes/actors)")
+    from ray_tpu._private.gcs_client import GcsClient
+    _install_token(args)
+    host, port = args.address.rsplit(":", 1)
+    client = GcsClient((host, int(port)))
+    try:
+        if kind == "nodes":
+            # rpc_addr is None exactly for in-driver (head) logical
+            # nodes (gcs.NodeInfo contract); raylet processes carry
+            # their lease endpoint.
+            return [{
+                "node_id": i.node_id.hex(), "alive": i.alive,
+                "is_head": i.rpc_addr is None,
+                "remote": i.rpc_addr is not None,
+                "resources_total": dict(i.resources_total),
+            } for i in client.get_all_node_info()]
+        return [{
+            "actor_id": a.actor_id.hex(), "class_name": a.class_name,
+            "state": a.state, "name": a.name or "",
+            "num_restarts": a.num_restarts,
+        } for a in client.list_actors()]
+    finally:
+        client.close()
+
+
+def _cmd_list(args) -> int:
+    """``ray_tpu list tasks|actors|objects|nodes|workers`` — the
+    reference's ``ray list`` surface over util/state."""
+    rows = _fetch_state(args, args.what)
+    if args.format == "json":
+        import json as _json
+        print(_json.dumps(rows, indent=2, default=str))
+        return 0
+    cols = _LIST_COLUMNS[args.what]
+    if rows and not any(c in rows[0] for c in cols):
+        cols = list(rows[0].keys())[:6]
+    _render_table(rows, cols)
+    print(f"\n{len(rows)} row(s)")
+    return 0
+
+
+def _cmd_timeline(args) -> int:
+    """``ray_tpu timeline`` — export the task timeline as Chrome-trace
+    JSON (open in chrome://tracing / Perfetto), the reference's
+    ``ray timeline``."""
+    import json as _json
+    import urllib.request
+    if not args.dashboard:
+        raise SystemExit("timeline needs a live driver: "
+                         "--dashboard HOST:PORT")
+    url = f"http://{args.dashboard}/api/timeline"
+    with urllib.request.urlopen(url, timeout=30) as r:
+        events = _json.loads(r.read().decode())
+    with open(args.out, "w") as f:
+        _json.dump(events, f)
+    print(f"wrote {len(events)} span(s) to {args.out}")
+    return 0
+
+
 def _cmd_stop(args) -> int:
     """Terminate this session's GCS/raylet processes (by port files +
     process table)."""
@@ -375,6 +475,25 @@ def main(argv=None) -> int:
     sp.add_argument("--token", default="",
                     help="session token (joiners: as printed by --head)")
     sp.set_defaults(fn=_cmd_start)
+
+    sp = sub.add_parser("list", help="list tasks/actors/objects/nodes/"
+                                     "workers (ray list analog)")
+    sp.add_argument("what", choices=sorted(_LIST_COLUMNS))
+    sp.add_argument("--dashboard", default="",
+                    help="live driver's dashboard HOST:PORT (all kinds)")
+    sp.add_argument("--address", default="",
+                    help="GCS HOST:PORT (nodes/actors only)")
+    sp.add_argument("--token", default="")
+    sp.add_argument("--format", choices=("table", "json"),
+                    default="table")
+    sp.set_defaults(fn=_cmd_list)
+
+    sp = sub.add_parser("timeline",
+                        help="export Chrome-trace task timeline")
+    sp.add_argument("--dashboard", required=True,
+                    help="live driver's dashboard HOST:PORT")
+    sp.add_argument("--out", default="timeline.json")
+    sp.set_defaults(fn=_cmd_timeline)
 
     sp = sub.add_parser("status", help="cluster state from the GCS")
     sp.add_argument("--address", required=True)

@@ -5,6 +5,7 @@ dashboard API tests [UNVERIFIED — mount empty, SURVEY.md §0].
 """
 
 import json
+import os
 import urllib.request
 
 import pytest
@@ -129,3 +130,94 @@ def test_dashboard_api_endpoints_full(ray_start_regular):
             assert e.code == 404
     finally:
         stop_dashboard()
+
+
+def test_dashboard_timeline_api_and_tab(ray_start_regular):
+    """/api/timeline serves Chrome-trace spans from a live run and the
+    single-file UI carries the timeline tab (reference: `ray timeline`
+    + the dashboard timeline view)."""
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def traced(i):
+        return i * 2
+
+    assert ray_tpu.get([traced.remote(i) for i in range(4)]) \
+        == [0, 2, 4, 6]
+    host, port = start_dashboard()
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/api/timeline", timeout=30) as r:
+            spans = json.loads(r.read().decode())
+        assert spans, "no spans from a run with finished tasks"
+        one = spans[0]
+        assert one["ph"] == "X" and one["dur"] >= 0 and "name" in one
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/", timeout=30) as r:
+            html = r.read().decode()
+        assert "timeline" in html
+    finally:
+        stop_dashboard()
+
+
+def test_cli_list_and_timeline(ray_start_regular, tmp_path):
+    """`ray_tpu list <kind> --dashboard` renders tables over the state
+    API; `ray_tpu timeline` exports Chrome-trace JSON (reference:
+    `ray list tasks`, `ray timeline`)."""
+    import subprocess
+    import sys
+
+    from ray_tpu.dashboard import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    @ray_tpu.remote
+    def tsk():
+        return 1
+
+    svc = Svc.options(name="cli_svc").remote()
+    assert ray_tpu.get(svc.ping.remote()) == "pong"
+    assert ray_tpu.get(tsk.remote()) == 1
+    host, port = start_dashboard()
+    dash = f"{host}:{port}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+
+    def cli(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu", *args],
+            capture_output=True, text=True, env=env, timeout=60)
+
+    try:
+        out = cli("list", "actors", "--dashboard", dash)
+        assert out.returncode == 0, out.stderr
+        assert "Svc" in out.stdout and "ALIVE" in out.stdout
+        assert "CLASS_NAME" in out.stdout      # table header
+        out = cli("list", "tasks", "--dashboard", dash)
+        assert out.returncode == 0, out.stderr
+        assert "tsk" in out.stdout and "finished" in out.stdout
+        out = cli("list", "nodes", "--dashboard", dash)
+        assert out.returncode == 0, out.stderr
+        assert "True" in out.stdout
+        out = cli("list", "objects", "--dashboard", dash,
+                  "--format", "json")
+        assert out.returncode == 0, out.stderr
+        json.loads(out.stdout)
+        # driver-owned kinds refuse a GCS-only route with guidance
+        out = cli("list", "tasks", "--address", "127.0.0.1:1")
+        assert out.returncode != 0
+        assert "--dashboard" in (out.stderr + out.stdout)
+
+        trace_path = tmp_path / "trace.json"
+        out = cli("timeline", "--dashboard", dash,
+                  "--out", str(trace_path))
+        assert out.returncode == 0, out.stderr
+        spans = json.loads(trace_path.read_text())
+        assert spans and all(e["ph"] == "X" for e in spans)
+    finally:
+        stop_dashboard()
+        ray_tpu.kill(svc)
