@@ -26,6 +26,14 @@ class StopTrial(Exception):
     need to catch it — the trial actor does and exits cleanly."""
 
 
+class ElasticResize(Exception):
+    """Raised inside ``report()`` when the elastic trainer wants the
+    gang to stop at this checkpoint boundary and re-form at a new
+    world size (capacity returned after a shrink). The worker actor
+    catches it and exits cleanly; training resumes from the latest
+    checkpoint at the new size."""
+
+
 @dataclass
 class TrainContext:
     world_size: int = 1
@@ -117,6 +125,21 @@ def report(metrics: Dict[str, Any],
         pickle.dump(payload, f)
     name = f"report_{ctx.rank:04d}_{ctx._report_seq:08d}.pkl"
     os.rename(tmp, os.path.join(ctx.report_dir, name))
+    # AFTER the report lands: an elastic re-form happens at a
+    # RANK-AGREED boundary — the RESIZE file carries the target report
+    # seq (stamped ahead of every rank's progress), and each rank
+    # stops at exactly that seq. Stopping at "whenever I next see the
+    # file" would let ranks leave at different steps and wedge the
+    # survivors' next collective.
+    resize_path = os.path.join(ctx.report_dir, "RESIZE")
+    if os.path.exists(resize_path):
+        try:
+            with open(resize_path) as f:
+                target_seq = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            target_seq = 0
+        if ctx._report_seq >= target_seq:
+            raise ElasticResize()
     if ctx.sync_reports:
         # Block until the controller acks this report (or tells us to
         # stop). Bounded wait so a dead controller can't wedge the trial.

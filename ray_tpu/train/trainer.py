@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.train._session import (
+    ElasticResize,
     TrainContext,
     get_context,
     init_session,
@@ -43,6 +44,14 @@ class ScalingConfig:
     use_tpu: bool = False
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
+    # Elastic training (reference: Train v2 controller-based elastic):
+    # when set, a failed attempt that can no longer reserve the full
+    # gang SHRINKS to whatever fits (>= min_workers) and continues
+    # from the latest checkpoint (the Orbax resharding restore handles
+    # the new layout); when capacity returns, the gang stops at the
+    # next checkpoint boundary and re-forms at full size. None keeps
+    # strict fixed-size gang-restart semantics.
+    min_workers: Optional[int] = None
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})
@@ -104,7 +113,12 @@ class _TrainWorker:
                 setup = cloudpickle.loads(setup_blob)
                 teardown = setup(ctx)
             loop = cloudpickle.loads(loop_blob)
-            loop(ctx.config) if _wants_arg(loop) else loop()
+            try:
+                loop(ctx.config) if _wants_arg(loop) else loop()
+            except ElasticResize:
+                # clean stop at a checkpoint boundary: the gang is
+                # re-forming at a new world size
+                return "__elastic_resize__"
             return True
         finally:
             if teardown is not None:
@@ -161,10 +175,21 @@ class DataParallelTrainer:
         failures_left = self._run_config.failure_config.max_failures
         latest_ckpt = self._resume_ckpt
         history: List[Dict[str, Any]] = []
+        # live view for observers (tests, progress displays)
+        self.metrics_history = history
+        target = self._scaling.num_workers
+        min_workers = self._scaling.min_workers
+        world_size = target
         while True:
             try:
-                metrics, latest_ckpt = self._run_attempt(
-                    trial_dir, latest_ckpt, history)
+                metrics, latest_ckpt, resized = self._run_attempt(
+                    trial_dir, latest_ckpt, history,
+                    world_size=world_size, target=target)
+                if resized:
+                    # clean stop at a checkpoint boundary: capacity is
+                    # back — re-form the gang at full size
+                    world_size = target
+                    continue
                 return Result(metrics=metrics, checkpoint=latest_ckpt,
                               path=trial_dir, metrics_history=history)
             except Exception as e:
@@ -179,15 +204,63 @@ class DataParallelTrainer:
                                   error=e, metrics_history=history)
                 if failures_left > 0:
                     failures_left -= 1
+                if min_workers is not None:
+                    # elastic: continue at whatever gang still fits
+                    world_size = self._feasible_world_size(
+                        target, min_workers)
+
+    def _feasible_world_size(self, target: int, min_workers: int) -> int:
+        """Largest gang (min_workers..target) the cluster can host
+        right now, established by PROBING placement (a short reserve/
+        release per size). The resource VIEW is not trusted: right
+        after a node dies it still advertises the dead capacity until
+        the health manager fires, and a view-based answer would retry
+        the full gang against a cluster that can no longer host it.
+        O(log n) probes: target first (the common not-a-capacity-loss
+        failure costs ONE probe), then binary search below it."""
+        from ray_tpu.util.placement_group import (
+            placement_group, remove_placement_group)
+        res = self._scaling.worker_resources()
+
+        def fits(k: int) -> bool:
+            pg = placement_group(
+                [dict(res) for _ in range(k)],
+                strategy=self._scaling.placement_strategy)
+            ok = pg.wait(8.0)
+            remove_placement_group(pg)
+            return ok
+
+        lo = max(min_workers, 1)
+        if fits(target):
+            return target
+        hi = target - 1
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if fits(mid):
+                lo = mid
+            else:
+                hi = mid - 1
+        return lo
+
+    def _grow_possible(self, current: int, target: int) -> bool:
+        res = self._scaling.worker_resources()
+        avail = ray_tpu.available_resources()
+        extra = target - current
+        return all(avail.get(k, 0.0) >= v * extra
+                   for k, v in res.items() if v > 0)
 
     def _run_attempt(self, trial_dir: str,
                      latest_ckpt: Optional[Checkpoint],
-                     history: List[Dict[str, Any]]):
+                     history: List[Dict[str, Any]],
+                     world_size: Optional[int] = None,
+                     target: Optional[int] = None):
         from ray_tpu.util.placement_group import (
             placement_group, remove_placement_group)
 
         scfg = self._scaling
-        n = scfg.num_workers
+        n = world_size or scfg.num_workers
+        target = target or scfg.num_workers
+        elastic = scfg.min_workers is not None
         res = scfg.worker_resources()
         report_dir = tempfile.mkdtemp(prefix="rtpu_reports_")
         group_name = f"train_{uuid.uuid4().hex[:8]}"
@@ -235,18 +308,58 @@ class DataParallelTrainer:
                 refs.append(w.run.remote(blob, ctx_fields, shards[i],
                                          setup_blob))
 
+            import time as _t
+            resized = False
+            grow_requested = False
+            next_grow_check = _t.monotonic() + 1.0
             while True:
                 ready, not_ready = ray_tpu.wait(
                     refs, num_returns=len(refs), timeout=0.2)
                 seen, latest_ckpt = self._drain_reports(
                     report_dir, seen, history, latest_ckpt)
+                if (elastic and n < target and not grow_requested
+                        and _t.monotonic() >= next_grow_check):
+                    next_grow_check = _t.monotonic() + 1.0
+                    if self._grow_possible(n, target):
+                        # ask the shrunken gang to stop at a
+                        # RANK-AGREED checkpoint boundary: a seq
+                        # ahead of every rank's current progress, so
+                        # no rank leaves a step another rank still
+                        # expects collectives from
+                        max_seq = 0
+                        for fname in seen:
+                            try:
+                                max_seq = max(
+                                    max_seq,
+                                    int(fname.split("_")[-1]
+                                        .split(".")[0]))
+                            except (ValueError, IndexError):
+                                pass
+                        tmp_path = os.path.join(report_dir,
+                                                "RESIZE.tmp")
+                        with open(tmp_path, "w") as rf:
+                            rf.write(str(max_seq + 2))
+                        os.replace(tmp_path,
+                                   os.path.join(report_dir, "RESIZE"))
+                        grow_requested = True
+                if ready and len(ready) < len(refs):
+                    # GANG semantics: a rank that failed must abort the
+                    # attempt NOW — waiting for the survivors to finish
+                    # would let them run the rest of the job at the
+                    # wrong world size. (Healthy early finishers pass
+                    # through this get unharmed.)
+                    ray_tpu.get(ready)
                 if len(ready) == len(refs):
-                    ray_tpu.get(ready)  # surface worker exceptions
+                    outs = ray_tpu.get(ready)  # surface worker exceptions
+                    # resized only if a worker actually STOPPED for the
+                    # resize; a loop that finished anyway is just done
+                    resized = any(o == "__elastic_resize__"
+                                  for o in outs)
                     break
             seen, latest_ckpt = self._drain_reports(
                 report_dir, seen, history, latest_ckpt)
             metrics = history[-1] if history else {}
-            return metrics, latest_ckpt
+            return metrics, latest_ckpt, resized
         finally:
             try:
                 seen, latest_ckpt = self._drain_reports(

@@ -159,3 +159,4 @@ def test_jax_training_loop_learns():
         loop, scaling_config=ScalingConfig(num_workers=1)).fit()
     assert res.error is None
     assert res.metrics["loss"] < 1e-2
+
