@@ -184,11 +184,15 @@ class ExecutionEnv:
                     pool.submit(
                         lambda p=p: send(self.execute(p, emit=send)))
                 return
-            # One reply per call AS PRODUCED — coalescing the whole
-            # batch into one frame would withhold the first call's
-            # result until the last finishes, a pipelined-consumption
-            # latency cliff for slow methods (reply batching is only
-            # a win on the async loop, which flushes incrementally).
+            # One reply per call AS PRODUCED. Coalescing is tempting
+            # (one frame per batch) but fundamentally unsafe here:
+            # execution is serial and the next call's duration is
+            # unknown, so ANY withheld reply can wait an unbounded
+            # time behind a slow successor (a time-bounded flush was
+            # tried and still withheld a finished reply for a 3 s
+            # follower — the flush check runs between calls, when no
+            # time has passed yet). Reply batching lives on the async
+            # loop, whose event-loop iterations make it safe.
             for p in payloads:
                 send(self.execute(p, emit=send))
             return
