@@ -378,27 +378,99 @@ class NodeManagerGroup:
                 return None       # holder died: object lost
         return self.object_server_addr
 
+    def _handle_remote_build_error(self, handle: RemoteNodeHandle,
+                                   spec: TaskSpec, err) -> None:
+        self._free_allocation(handle.node_id, spec.resources,
+                              self._spec_pg(spec))
+        if isinstance(err, _DependencyError):
+            self._complete_task(spec.task_id, [], err.entry.data, None)
+        elif isinstance(err, _LostArgError):
+            recovered = (self._recover_object_cb(err.object_id)
+                         if self._recover_object_cb else False)
+            if recovered:
+                self.submit_task(spec)
+            elif self._fail_task_cb is not None:
+                from ray_tpu.exceptions import ObjectLostError
+                self._fail_task_cb(spec, ObjectLostError(
+                    f"argument {err.object_id} of {spec.repr_name()} "
+                    "was lost and cannot be reconstructed"))
+        else:
+            self._complete_task(spec.task_id, [], None, err)
+
+    def _dispatch_remote_batch(self, handle: RemoteNodeHandle,
+                               specs: List[TaskSpec]) -> None:
+        """One lease RPC for N tasks bound for the same raylet (the
+        submit half of the remote wire path; statuses come back per
+        payload so spillback refusals stay per-task)."""
+        if len(specs) == 1:
+            self._dispatch_remote(handle, specs[0])
+            return
+        sendable: List[Tuple[TaskSpec, dict]] = []
+        for spec in specs:
+            payload, err = self._build_remote_payload(handle, spec)
+            if err is not None:
+                self._handle_remote_build_error(handle, spec, err)
+                continue
+            sendable.append((spec, payload))
+        if not sendable:
+            return
+        with self._lock:
+            for spec, _p in sendable:
+                self._running[spec.task_id] = RunningTask(
+                    spec, handle.node_id, _RemoteLease(handle),
+                    dict(spec.resources), pg=self._spec_pg(spec))
+        # Timeout scales with the frame: the single-lease bound is
+        # sized for one payload, and an N-task frame's transfer time
+        # grows with N — timing out a frame the raylet already
+        # admitted would duplicate-execute every task in it.
+        lease_timeout = (get_config().worker_lease_timeout_ms / 1000.0
+                         + 0.05 * len(sendable))
+        try:
+            statuses = handle.client.call(
+                "submit_many", [p for _s, p in sendable],
+                timeout=lease_timeout)
+        except Exception:
+            statuses = None
+        if (not isinstance(statuses, list)
+                or len(statuses) != len(sendable)):
+            # whole frame lost (or a malformed reply — treat the same
+            # rather than zip-truncating and stranding the tail in
+            # _running with its allocations held): reschedule all
+            for spec, _p in sendable:
+                self._requeue_remote(handle, spec)
+            self._wake.set()
+            return
+        from ray_tpu._private import events
+        requeued = False
+        for (spec, _p), status in zip(sendable, statuses):
+            if status == "refused":
+                self._requeue_remote(handle, spec)
+                requeued = True
+            else:
+                events.record(spec.task_id.hex(), spec.repr_name(),
+                              "RUNNING",
+                              worker=f"node:{handle.node_id.hex()[:8]}")
+        if requeued:
+            self._wake.set()
+
+    def _requeue_remote(self, handle: RemoteNodeHandle,
+                        spec: TaskSpec) -> None:
+        """Unwind one remote submission (frame lost / spillback
+        refusal): drop the running record, return the allocation,
+        requeue for scheduling."""
+        with self._lock:
+            self._running.pop(spec.task_id, None)
+        self._free_allocation(handle.node_id, spec.resources,
+                              self._spec_pg(spec))
+        with self._lock:
+            self._to_schedule.append(spec)
+
     def _dispatch_remote(self, handle: RemoteNodeHandle, spec: TaskSpec
                          ) -> None:
         """Ship a scheduled task to a remote raylet (lease+exec)."""
         payload, err = self._build_remote_payload(handle, spec)
         if err is not None:
-            self._free_allocation(handle.node_id, spec.resources,
-                                  self._spec_pg(spec))
-            if isinstance(err, _DependencyError):
-                self._complete_task(spec.task_id, [], err.entry.data, None)
-            elif isinstance(err, _LostArgError):
-                recovered = (self._recover_object_cb(err.object_id)
-                             if self._recover_object_cb else False)
-                if recovered:
-                    self.submit_task(spec)
-                elif self._fail_task_cb is not None:
-                    from ray_tpu.exceptions import ObjectLostError
-                    self._fail_task_cb(spec, ObjectLostError(
-                        f"argument {err.object_id} of {spec.repr_name()} "
-                        "was lost and cannot be reconstructed"))
-            else:
-                self._complete_task(spec.task_id, [], None, err)
+            self._handle_remote_build_error(handle, spec, err)
             return
         with self._lock:
             self._running[spec.task_id] = RunningTask(
@@ -409,23 +481,13 @@ class NodeManagerGroup:
             status = handle.client.call("submit", payload,
                                         timeout=lease_timeout)
         except Exception:
-            with self._lock:
-                self._running.pop(spec.task_id, None)
-            self._free_allocation(handle.node_id, spec.resources,
-                                  self._spec_pg(spec))
-            with self._lock:
-                self._to_schedule.append(spec)
+            self._requeue_remote(handle, spec)
             self._wake.set()
             return
         if status == "refused":
             # Spillback: the raylet's authoritative view says this can
             # never fit; reschedule elsewhere.
-            with self._lock:
-                self._running.pop(spec.task_id, None)
-            self._free_allocation(handle.node_id, spec.resources,
-                                  self._spec_pg(spec))
-            with self._lock:
-                self._to_schedule.append(spec)
+            self._requeue_remote(handle, spec)
             self._wake.set()
             return
         from ray_tpu._private import events
@@ -1321,6 +1383,12 @@ class NodeManagerGroup:
             requests.append(req)
         results = self._policy.schedule_batch(
             self.cluster_resources, requests) if requests else []
+        # Remote dispatches coalesce into ONE lease RPC per raylet per
+        # tick (the reference's lease-request batching): the per-task
+        # submit round trip otherwise serializes the scheduler loop on
+        # the network.
+        remote_batches: Dict[NodeID, Tuple[RemoteNodeHandle,
+                                           List[TaskSpec]]] = {}
         for spec, res in zip(batch, results):
             if res.node_id is None:
                 if res.is_infeasible:
@@ -1343,7 +1411,8 @@ class NodeManagerGroup:
                     self.cluster_resources.free(res.node_id, spec.resources)
                     retry.append(spec)
                 else:
-                    self._dispatch_remote(remote, spec)
+                    remote_batches.setdefault(
+                        res.node_id, (remote, []))[1].append(spec)
                 continue
             with self._lock:
                 raylet = self._raylets.get(res.node_id)
@@ -1352,6 +1421,8 @@ class NodeManagerGroup:
                     retry.append(spec)
                     continue
                 raylet.dispatch_queue.append(spec)
+        for handle, specs in remote_batches.values():
+            self._dispatch_remote_batch(handle, specs)
         if retry:
             with self._lock:
                 self._to_schedule.extend(retry)

@@ -118,6 +118,7 @@ class RayletServer:
         self.server.register("read_logs", self._handle_read_logs)
         self.server.register("dump_stacks", self._handle_dump_stacks)
         self.server.register("submit", self._handle_submit)
+        self.server.register("submit_many", self._handle_submit_many)
         self.server.register("submit_batch", self._handle_submit_batch)
         self.server.register("kill_actor", self._handle_kill_actor)
         self.server.register("cancel_actor_task",
@@ -247,6 +248,22 @@ class RayletServer:
     def _handle_submit(self, ctx: ConnectionContext, payload: dict) -> str:
         """Admit a task payload. Returns "ok" or "refused" (spillback:
         the demand can never fit this node)."""
+        status = self._admit_payload(ctx, payload)
+        if status == "ok":
+            self._wake.set()
+        return status
+
+    def _handle_submit_many(self, ctx: ConnectionContext,
+                            payloads: list) -> List[str]:
+        """Admit N task payloads in ONE lease round trip (the owner
+        coalesces per-raylet); per-payload statuses keep spillback
+        refusals per-task."""
+        statuses = [self._admit_payload(ctx, p) for p in payloads]
+        if any(s == "ok" for s in statuses):
+            self._wake.set()
+        return statuses
+
+    def _admit_payload(self, ctx: ConnectionContext, payload: dict) -> str:
         demand = payload.get("resources") or {}
         for name, need in demand.items():
             if need > self.resources_total.get(name, 0.0) + 1e-9:
@@ -262,7 +279,6 @@ class RayletServer:
                 if payload.pop("detached", False):
                     self._detached.add(aid)
             self._dispatch_queue.append(payload)
-        self._wake.set()
         return "ok"
 
     def _handle_submit_batch(self, ctx: ConnectionContext,

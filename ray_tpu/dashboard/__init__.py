@@ -40,7 +40,46 @@ _PAGE = """<!doctype html>
 <p class="mut"><a href="/metrics">/metrics</a> (Prometheus)</p>
 <script>
 const TABS = ["summary","nodes","actors","tasks","objects","workers",
-              "timeline"];
+              "timeline","metrics"];
+// metrics tab: the browser polls /metrics and keeps its own history —
+// sparkline time series without any server-side state
+const SERIES = {};
+async function pollMetrics(){
+  try {
+    const text = await (await fetch("/metrics")).text();
+    for (const line of text.split("\\n")) {
+      if (!line || line.startsWith("#")) continue;
+      const sp = line.lastIndexOf(" ");
+      const name = line.slice(0, sp), v = parseFloat(line.slice(sp+1));
+      if (!isFinite(v)) continue;
+      (SERIES[name] = SERIES[name] || []).push(v);
+      if (SERIES[name].length > 120) SERIES[name].shift();
+    }
+  } catch (e) {}
+}
+setInterval(pollMetrics, 3000); pollMetrics();
+function spark(vals, w, h){
+  const mn = Math.min(...vals), mx = Math.max(...vals);
+  const span = (mx - mn) || 1;
+  const pts = vals.map((v,i) =>
+    `${(i/(Math.max(vals.length-1,1)))*w},${h-2-((v-mn)/span)*(h-6)}`);
+  return `<polyline points="${pts.join(" ")}" fill="none" `
+    + `stroke="#2a6df4" stroke-width="1.5"/>`;
+}
+function metricsView(){
+  const names = Object.keys(SERIES).sort();
+  if (!names.length) return "<p>collecting…</p>";
+  let s = `<p class="mut">${names.length} series · 3s samples · `
+    + `last ${SERIES[names[0]].length} points (browser-side)</p><table>`;
+  for (const n of names){
+    const vals = SERIES[n];
+    const last = vals[vals.length-1];
+    s += `<tr><td>${esc(n)}</td><td>${last}</td>`
+      + `<td><svg width="240" height="36">${spark(vals,238,36)}</svg>`
+      + `</td></tr>`;
+  }
+  return s + "</table>";
+}
 let tab = location.hash.slice(1) || "summary";
 const nav = document.getElementById("nav");
 TABS.forEach(t => {
@@ -101,6 +140,12 @@ async function render(){
   TABS.forEach(t => document.getElementById("tab-"+t)
     .classList.toggle("on", t === tab));
   try {
+    if (tab === "metrics") {
+      document.getElementById("content").innerHTML = metricsView();
+      document.getElementById("refreshed").textContent =
+        "· " + new Date().toLocaleTimeString();
+      return;
+    }
     const data = await (await fetch("/api/" + tab)).json();
     document.getElementById("content").innerHTML =
       tab === "summary" ? "<pre>" +

@@ -477,3 +477,19 @@ def test_raylet_heartbeat_reports_real_availability(ray_start_cluster):
         time.sleep(0.2)
     else:
         pytest.fail(f"heartbeat did not recover: {w.node_reports.get(nid)}")
+
+
+def test_remote_submit_batching_wave(ray_start_cluster):
+    """A wave of tasks bound for one remote raylet coalesces into
+    submit_many lease frames (one RPC per raylet per tick) — every
+    task still completes and per-task spillback semantics hold."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4, resources={"W": 100}, remote=True)
+
+    @ray_tpu.remote(num_cpus=0.01, resources={"W": 0.5})
+    def bump(i):
+        return i * 3
+
+    refs = [bump.remote(i) for i in range(120)]
+    out = ray_tpu.get(refs, timeout=300)
+    assert out == [i * 3 for i in range(120)]

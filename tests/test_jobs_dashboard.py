@@ -155,7 +155,8 @@ def test_dashboard_timeline_api_and_tab(ray_start_regular):
         with urllib.request.urlopen(
                 f"http://{host}:{port}/", timeout=30) as r:
             html = r.read().decode()
-        assert "timeline" in html
+        assert "timeline" in html and "metrics" in html
+        assert "pollMetrics" in html      # browser-side series tab
     finally:
         stop_dashboard()
 
