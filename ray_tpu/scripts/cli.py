@@ -196,6 +196,34 @@ def _cmd_list(args) -> int:
     return 0
 
 
+def _cmd_memory(args) -> int:
+    """``ray_tpu memory`` — object-store usage + per-object reference
+    breakdown from a live driver (the reference's ``ray memory``)."""
+    summary = _fetch_state(args, "summary")
+    objs = _fetch_state(args, "objects")
+    print("OBJECT STORE")
+    for store in ("objects", "device_objects"):
+        stats = summary.get(store, {})
+        if stats:
+            print(f"  {store}: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(stats.items())))
+    by_loc: dict = {}
+    for o in objs:
+        by_loc.setdefault(o.get("location", "?"), []).append(o)
+    # the list endpoint caps at 500 rows; the summary total is
+    # authoritative — never report a truncated length as the total
+    total = summary.get("live_refs", len(objs))
+    print(f"\n{total} live object reference(s); by location"
+          + (f" (newest {len(objs)} shown)" if len(objs) < total
+             else "") + ":")
+    for loc in sorted(by_loc):
+        print(f"  {loc}: {len(by_loc[loc])}")
+    if args.verbose:
+        print()
+        _render_table(objs, _LIST_COLUMNS["objects"])
+    return 0
+
+
 def _cmd_timeline(args) -> int:
     """``ray_tpu timeline`` — export the task timeline as Chrome-trace
     JSON (open in chrome://tracing / Perfetto), the reference's
@@ -487,6 +515,15 @@ def main(argv=None) -> int:
     sp.add_argument("--format", choices=("table", "json"),
                     default="table")
     sp.set_defaults(fn=_cmd_list)
+
+    sp = sub.add_parser("memory",
+                        help="object-store usage + live refs "
+                             "(ray memory analog)")
+    sp.add_argument("--dashboard", required=True,
+                    help="live driver's dashboard HOST:PORT")
+    sp.add_argument("--verbose", action="store_true",
+                    help="also print the per-object table")
+    sp.set_defaults(fn=_cmd_memory)
 
     sp = sub.add_parser("timeline",
                         help="export Chrome-trace task timeline")

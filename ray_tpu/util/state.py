@@ -162,5 +162,8 @@ def summary() -> dict:
         "tasks": tm,
         "objects": w.shm_store.stats(),
         "device_objects": w.device_store.stats(),
+        # authoritative ref total — list endpoints cap at 500 rows, so
+        # consumers (ray_tpu memory) report THIS, not a list length
+        "live_refs": len(w.reference_counter.snapshot()),
         "scheduler": w.node_group.stats(),
     }

@@ -213,6 +213,11 @@ def test_cli_list_and_timeline(ray_start_regular, tmp_path):
         assert out.returncode != 0
         assert "--dashboard" in (out.stderr + out.stdout)
 
+        out = cli("memory", "--dashboard", dash)
+        assert out.returncode == 0, out.stderr
+        assert "OBJECT STORE" in out.stdout
+        assert "live object reference" in out.stdout
+
         trace_path = tmp_path / "trace.json"
         out = cli("timeline", "--dashboard", dash,
                   "--out", str(trace_path))
