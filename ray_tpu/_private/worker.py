@@ -1770,6 +1770,25 @@ class Worker:
             status = self.task_manager.mark_cancelled(task_id)
             if status in ("finished", "failed"):
                 return
+            # still queued at the DRIVER (actor mid-creation, or queue
+            # backlog): dequeue now — it must never be flushed
+            with self._actor_lock:
+                q = self._actor_queues.get(actor_id)
+                removed = None
+                if q:
+                    for s in q:
+                        if s.task_id == task_id:
+                            removed = s
+                            break
+                    if removed is not None:
+                        q.remove(removed)
+            if removed is not None:
+                # complete_task substitutes the canonical cancelled
+                # message for flagged records; this exception is just
+                # the terminal-failure trigger
+                self.task_manager.complete_task(
+                    task_id, [], None, TaskCancelledError("cancelled"))
+                return
             self.node_group.cancel_actor_call(actor_id, task_id)
             return
         if rec.spec.task_type != TaskType.NORMAL_TASK:

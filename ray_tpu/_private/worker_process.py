@@ -697,6 +697,9 @@ class _AsyncActorLoop:
         self.loop = asyncio.new_event_loop()
         self._sem: Optional["asyncio.Semaphore"] = None
         self._inflight: Dict[bytes, "asyncio.Task"] = {}
+        # insertion-ordered pre-arrival cancel markers (dict-as-set:
+        # oldest-first eviction under the stale-entry bound)
+        self._cancelled: Dict[bytes, None] = {}
         self._buf: list = []
         self._flush_scheduled = False
         self._send: Optional[Callable[[tuple], None]] = None
@@ -748,16 +751,34 @@ class _AsyncActorLoop:
         for p in payloads:
             task = self.loop.create_task(self._call(p))
             self._inflight[p["task_id"]] = task
+            if p["task_id"] in self._cancelled:
+                # the cancel RACED AHEAD of the call frame (owner-side
+                # queue flush vs cancel delivery): honor it on arrival.
+                # DEFERRED past the coroutine's first step — cancelling
+                # a never-started coroutine skips _call's body entirely,
+                # so no reply would ever reach the owner (hung ref).
+                self._cancelled.pop(p["task_id"], None)
+                self.loop.call_soon(task.cancel)
 
     def cancel(self, task_id: bytes) -> None:
         """Cancel one in-flight call via asyncio cancellation
         (reference: ray.cancel on async-actor tasks). Queued calls
         (semaphore waiters) cancel immediately; a running coroutine
-        gets CancelledError at its next await point. Thread-safe."""
+        gets CancelledError at its next await point; a cancel arriving
+        BEFORE its call frame is remembered and applied on arrival.
+        Thread-safe."""
         def _do():
             task = self._inflight.get(task_id)
             if task is not None:
-                task.cancel()
+                # deferred for the same never-started-coroutine reason
+                # as in _start_batch
+                self.loop.call_soon(task.cancel)
+                return
+            while len(self._cancelled) > 4096:
+                # bound stale markers by evicting the OLDEST — a
+                # wholesale clear would drop live racing cancels too
+                self._cancelled.pop(next(iter(self._cancelled)), None)
+            self._cancelled[task_id] = None
         try:
             self.loop.call_soon_threadsafe(_do)
         except RuntimeError:
