@@ -177,6 +177,8 @@ def _reduce_fn_factory(kind: str, kwargs: Dict):
     aggs = kwargs.get("aggs")
     seed = kwargs.get("seed")
 
+    group_fn = kwargs.get("group_fn")
+
     def reduce(*parts):
         import pyarrow as pa
         blk = blib.concat_blocks(list(parts))
@@ -189,10 +191,31 @@ def _reduce_fn_factory(kind: str, kwargs: Dict):
                 rng = np.random.RandomState(seed)
                 blk = blk.take(pa.array(rng.permutation(blk.num_rows)))
         elif kind == "groupby":
-            blk = _aggregate_block(blk, key, aggs)
+            if group_fn is not None:
+                blk = _apply_group_fn(blk, key, group_fn)
+            else:
+                blk = _aggregate_block(blk, key, aggs)
         return blk
 
     return reduce
+
+
+def _apply_group_fn(blk, key: str, fn):
+    """map_groups reduce: this partition holds every row of each of
+    its key values (crc32 partitioning), so grouping is local — sort
+    by key, slice runs, apply ``fn`` per group as a numpy batch."""
+    if blk.num_rows == 0:
+        return blk
+    blk = blk.sort_by([(key, "ascending")])
+    col = np.asarray(blk.column(key).to_pylist(), dtype=object)
+    boundaries = np.flatnonzero(col[1:] != col[:-1]) + 1
+    starts = [0, *boundaries.tolist()]
+    ends = [*boundaries.tolist(), len(col)]
+    out = []
+    for s, e in zip(starts, ends):
+        batch = blib.block_to_batch(blk.slice(s, e - s))
+        out.append(blib.block_from_batch(fn(batch)))
+    return blib.concat_blocks(out)
 
 
 def _aggregate_block(blk, key: str, aggs: List):

@@ -116,6 +116,30 @@ class Dataset:
         return Dataset(UnionOp(self._op, [o._op for o in others]),
                        self._max_in_flight)
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two same-length datasets (reference:
+        ``Dataset.zip``; right-side column-name collisions get a
+        ``_1`` suffix). Materializes both sides to align rows."""
+        import pyarrow as pa
+        left = blib.concat_blocks(list(self.iter_blocks()))
+        right = blib.concat_blocks(list(other.iter_blocks()))
+        if left.num_rows != right.num_rows:
+            raise ValueError(
+                f"zip needs equal row counts: {left.num_rows} vs "
+                f"{right.num_rows}")
+        cols: Dict[str, Any] = {n: left.column(n)
+                                for n in left.column_names}
+        for n in right.column_names:
+            # walk the suffix until free — a fixed "_1" would silently
+            # overwrite a real left column named f"{n}_1"
+            out_name, i = n, 0
+            while out_name in cols:
+                i += 1
+                out_name = f"{n}_{i}"
+            cols[out_name] = right.column(n)
+        return Dataset(InputData([ray_tpu.put(pa.table(cols))]),
+                       self._max_in_flight)
+
     # -- execution ---------------------------------------------------------
 
     def _execute(self) -> Iterator[Any]:
@@ -205,6 +229,29 @@ class Dataset:
                 break
         return out
 
+    def take_batch(self, batch_size: int = 20, *,
+                   batch_format: str = "numpy"):
+        """First ``batch_size`` rows as one batch (reference:
+        ``Dataset.take_batch`` — like it, raises on an empty
+        dataset rather than returning a keyless dict)."""
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format=batch_format):
+            return batch
+        raise ValueError("dataset is empty")
+
+    def unique(self, col: str) -> List[Any]:
+        """Distinct values of a column (reference: ``Dataset.unique``;
+        returned sorted for determinism)."""
+        out: set = set()
+        for blk in self.iter_blocks():
+            if not blk.num_rows:
+                continue            # filtered-empty blocks are schema-less
+            out.update(blk.column(col).to_pylist())
+        try:
+            return sorted(out)
+        except TypeError:               # mixed un-orderable types
+            return list(out)
+
     def take_all(self) -> List[Any]:
         return list(self.iter_rows())
 
@@ -235,6 +282,30 @@ class Dataset:
         vals = [np.max(blib.block_to_batch(b)[col])
                 for b in self.iter_blocks() if b.num_rows]
         return max(vals) if vals else None
+
+    def std(self, col: str, ddof: int = 1) -> float:
+        """Sample standard deviation of a numeric column (reference:
+        ``Dataset.std``), streamed block-by-block. Accumulates around
+        a shift (the first value) — the naive sum-of-squares formula
+        catastrophically cancels when the mean dwarfs the spread."""
+        import math
+        n = 0
+        s = 0.0
+        ss = 0.0
+        shift = None
+        for blk in self.iter_blocks():
+            if not blk.num_rows:
+                continue
+            v = np.asarray(blib.block_to_batch(blk)[col], dtype=float)
+            if shift is None:
+                shift = float(v[0])
+            d = v - shift
+            n += d.size
+            s += float(d.sum())
+            ss += float((d * d).sum())
+        if n - ddof <= 0:
+            return float("nan")
+        return math.sqrt(max((ss - s * s / n) / (n - ddof), 0.0))
 
     def mean(self, col: str):
         tot, n = 0.0, 0
@@ -386,6 +457,15 @@ class GroupedData:
 
     def max(self, col: str) -> Dataset:
         return self._agg([(col, "max", f"max({col})")])
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """Apply ``fn`` once per group (reference:
+        ``GroupedData.map_groups``): rows are partitioned by key via
+        the two-level shuffle, then each group arrives at ``fn`` as a
+        numpy batch; ``fn`` returns a batch."""
+        return Dataset(AllToAll("MapGroups", self._ds._op, "groupby",
+                                key=self._key, group_fn=fn),
+                       self._ds._max_in_flight)
 
 
 # --------------------------------------------------------------------------

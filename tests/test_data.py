@@ -273,3 +273,61 @@ def test_two_level_shuffle_bounds_live_refs(ray_start_regular):
     # one-level would materialize >= N^2 = 65,536 intermediates; the
     # two-level bound is G*n_out = 16*256 = 4,096 plus inputs/outputs
     assert peak["owned"] < 20_000, peak
+
+
+def test_zip_unique_std_take_batch(ray_start_regular):
+    """Round-5 API breadth: zip / unique / std / take_batch
+    (reference: the same Dataset methods)."""
+    a = rdata.from_items([{"x": i} for i in range(10)], parallelism=3)
+    b = rdata.from_items([{"y": i * 2} for i in range(10)], parallelism=2)
+    z = a.zip(b)
+    rows = z.take_all()
+    assert rows[3] == {"x": 3, "y": 6}
+    # name collision gets the _1 suffix
+    z2 = a.zip(rdata.from_items([{"x": -i} for i in range(10)]))
+    assert set(z2.take(1)[0]) == {"x", "x_1"}
+
+    ds = rdata.from_items([{"v": x} for x in [3, 1, 3, 2, 1, 3]])
+    assert ds.unique("v") == [1, 2, 3]
+
+    import statistics
+    vals = [1.0, 2.0, 3.0, 4.0, 10.0]
+    ds2 = rdata.from_items([{"v": v} for v in vals], parallelism=2)
+    assert abs(ds2.std("v") - statistics.stdev(vals)) < 1e-9
+
+    batch = rdata.range(100, parallelism=4).take_batch(7)
+    assert len(batch["id"]) == 7
+    with pytest.raises(ValueError, match="empty"):
+        rdata.from_items([]).take_batch(5)
+    # empty (schema-less) blocks from a filter must not break unique
+    assert rdata.from_items([{"v": 1}, {"v": 5}], parallelism=2) \
+        .filter(lambda r: r["v"] > 2).unique("v") == [5]
+    # catastrophic-cancellation guard: huge mean, tiny spread
+    big = rdata.from_items([{"v": 1e8}, {"v": 1e8 + 1}])
+    assert abs(big.std("v") - statistics.stdev([1e8, 1e8 + 1])) < 1e-6
+    # zip collision suffix walks past existing _1 columns
+    left = rdata.from_items([{"x": 1, "x_1": 100}])
+    z3 = left.zip(rdata.from_items([{"x": -1}]))
+    assert z3.take(1)[0] == {"x": 1, "x_1": 100, "x_2": -1}
+
+
+def test_groupby_map_groups(ray_start_regular):
+    """GroupedData.map_groups: fn sees each key's full rows once,
+    through the two-level shuffle partitioning."""
+    import numpy as np
+
+    rows = [{"k": i % 3, "v": i} for i in range(30)]
+    ds = rdata.from_items(rows, parallelism=5)
+
+    def summarize(batch):
+        return {"k": batch["k"][:1],
+                "n": np.asarray([len(batch["v"])]),
+                "total": np.asarray([int(np.sum(batch["v"]))])}
+
+    out = sorted(ds.groupby("k").map_groups(summarize).take_all(),
+                 key=lambda r: r["k"])
+    assert [r["k"] for r in out] == [0, 1, 2]
+    assert all(r["n"] == 10 for r in out)
+    expect = {k: sum(i for i in range(30) if i % 3 == k)
+              for k in range(3)}
+    assert all(r["total"] == expect[r["k"]] for r in out)
