@@ -298,10 +298,10 @@ class NestedClient:
         return _Shim()
 
     def cluster_resources(self) -> dict:
-        return {}
+        return self._client.call("nested_cluster_resources")
 
     def available_resources(self) -> dict:
-        return {}
+        return self._client.call("nested_available_resources")
 
     def close(self) -> None:
         self._client.close()

@@ -678,6 +678,10 @@ class Worker:
         s.register("nested_kill_actor", self._nested_kill_actor)
         s.register("nested_cancel", self._nested_cancel)
         s.register("nested_named_actor", self._nested_named_actor)
+        s.register("nested_cluster_resources",
+                   lambda ctx: self.cluster_resources())
+        s.register("nested_available_resources",
+                   lambda ctx: self.available_resources())
         s.register("nested_create_pg",
                    lambda ctx, b, bundles, strat, name:
                    self.create_placement_group(

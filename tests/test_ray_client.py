@@ -20,7 +20,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture
 def client_cluster(tmp_path):
-    """GCS + client-server processes; yields (rtpu_addr, token)."""
+    """GCS + client-server processes; yields (rtpu_addr, token,
+    add_raylet) — the helper spawns extra cluster raylets (all reaped
+    at teardown)."""
     from ray_tpu._private import rpc as _rpc
     from ray_tpu._private.config import get_config
     from ray_tpu._private.gcs_server import spawn_gcs_process
@@ -52,9 +54,20 @@ def client_cluster(tmp_path):
         assert cs_proc.poll() is None, "client server died"
         time.sleep(0.05)
     assert addr, "client server never reported its address"
-    yield f"rtpu://{addr}", token
+    raylet_procs = []
+
+    def add_raylet(resources):
+        from ray_tpu._private.ids import NodeID
+        from ray_tpu._private.raylet_server import spawn_raylet_process
+        proc, _ = spawn_raylet_process(
+            f"{session}r{len(raylet_procs) + 1}", NodeID.from_random(),
+            resources, gcs_addr=gcs_addr, max_process_workers=2)
+        raylet_procs.append(proc)
+        return proc
+
+    yield f"rtpu://{addr}", token, add_raylet
     ray_tpu.shutdown()
-    for proc in (cs_proc, gcs_proc):
+    for proc in [*raylet_procs, cs_proc, gcs_proc]:
         proc.terminate()
         try:
             proc.wait(timeout=10)
@@ -63,7 +76,7 @@ def client_cluster(tmp_path):
 
 
 def test_client_tasks_objects_wait(client_cluster):
-    addr, _token = client_cluster
+    addr, _token, _add_raylet = client_cluster
     w = ray_tpu.init(address=addr)
     assert type(w).__name__ == "ClientWorker"
 
@@ -96,7 +109,7 @@ def test_client_tasks_objects_wait(client_cluster):
 
 
 def test_client_actors(client_cluster):
-    addr, _token = client_cluster
+    addr, _token, _add_raylet = client_cluster
     ray_tpu.init(address=addr)
 
     @ray_tpu.remote
@@ -112,3 +125,55 @@ def test_client_actors(client_cluster):
     assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
     assert ray_tpu.get(c.incr.remote(5), timeout=30) == 6
     ray_tpu.kill(c)
+
+
+def test_client_detached_actor_across_connections(client_cluster):
+    """Detached actors through the rtpu:// thin driver: connection A
+    creates a named detached actor hosted on a cluster raylet and
+    disconnects; connection B finds it by name with state intact
+    (reference: Ray Client + detached actor composition)."""
+    addr, _token, add_raylet = client_cluster
+    ray_tpu.init(address=addr)
+    # Baseline BEFORE the raylet exists: the proxied driver's own head
+    # node already contributes CPUs, so "total >= 2" alone would pass
+    # before the new node attaches (flake). Poll for the DELTA.
+    baseline = ray_tpu.cluster_resources().get("CPU", 0)
+    add_raylet({"CPU": 2.0})
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if ray_tpu.cluster_resources().get("CPU", 0) >= baseline + 2:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("added raylet never became visible")
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    Counter.options(name="cli_det", lifetime="detached",
+                    num_cpus=1).remote()
+    h = ray_tpu.get_actor("cli_det")
+    assert ray_tpu.get(h.incr.remote(), timeout=120) == 1
+    assert ray_tpu.get(h.incr.remote(), timeout=60) == 2
+    ray_tpu.shutdown()       # connection A gone
+
+    ray_tpu.init(address=addr)   # connection B
+    h2 = ray_tpu.get_actor("cli_det")
+    assert ray_tpu.get(h2.incr.remote(), timeout=120) == 3
+    ray_tpu.kill(h2)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get_actor("cli_det")
+        except ValueError:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("name not freed after kill")
+    ray_tpu.shutdown()
