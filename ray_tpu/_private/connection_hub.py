@@ -62,7 +62,7 @@ class ConnectionHub:
         try:
             self._listener.close()
         except Exception:
-            pass
+            pass    # listener socket may already be closed
         try:
             os.unlink(self.address)
         except OSError:

@@ -96,7 +96,7 @@ class DeviceStore:
                 try:
                     total += int(arr.nbytes)
                 except Exception:
-                    pass
+                    pass    # deleted/donated buffer: skip its bytes
             return {
                 "num_objects": len(self._arrays),
                 "hbm_bytes": total,

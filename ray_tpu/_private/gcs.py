@@ -10,6 +10,7 @@ touching callers.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import defaultdict
@@ -17,6 +18,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
+
+logger = logging.getLogger(__name__)
 
 
 class Publisher:
@@ -37,7 +40,10 @@ class Publisher:
             try:
                 cb(message)
             except Exception:
-                pass
+                # one bad subscriber must not starve the rest of the
+                # channel — log and keep fanning out
+                logger.exception("subscriber callback failed on %s",
+                                 channel)
 
 
 @dataclass

@@ -70,7 +70,7 @@ class GcsClient:
                 with self._cache_lock:
                     self._actor_cache.pop(message[1], None)
             except Exception:
-                pass
+                pass    # malformed push: cache entry just lives on
         self.publisher.publish(topic, message)
 
     # -- jobs ----------------------------------------------------------

@@ -114,6 +114,10 @@ class GcsServer:
                 name="rtpu-gcs-persist")
             self._persist_thread.start()
 
+    def rpc_methods(self) -> tuple:
+        """Live handler table (rpc-surface introspection hook)."""
+        return self.server.registered_methods()
+
     def _wrap_dirty(self, method: str) -> None:
         fn = self._handlers_get(method)
 

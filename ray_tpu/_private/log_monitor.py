@@ -21,10 +21,13 @@ tail plane over those files:
 from __future__ import annotations
 
 import glob
+import logging
 import os
 import sys
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 _MAX_READ_PER_FILE = 256 * 1024
 
@@ -146,7 +149,9 @@ class LogMonitor:
             try:
                 self.poll_once()
             except Exception:
-                pass
+                # keep the monitor thread alive across one bad poll
+                # (rotated file, racing unlink) but leave a trace
+                logger.debug("log poll failed", exc_info=True)
 
     def stop(self) -> None:
         self._stop.set()

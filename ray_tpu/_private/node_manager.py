@@ -46,8 +46,8 @@ class DependencyManager:
     """Tracks which queued tasks wait on which objects."""
 
     def __init__(self):
-        self._waiting_on: Dict[ObjectID, Set[TaskID]] = defaultdict(set)
-        self._remaining: Dict[TaskID, int] = {}
+        self._waiting_on: Dict[ObjectID, Set[TaskID]] = defaultdict(set)  # guarded-by: _lock
+        self._remaining: Dict[TaskID, int] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def add_task(self, task_id: TaskID, deps: List[ObjectID],
@@ -143,7 +143,7 @@ class RemoteActorWorker:
                 self.handle.client.call("kill_actor", self.actor_id_bytes,
                                         timeout=5)
             except Exception:
-                pass
+                pass    # raylet gone: node-lost path reaps the actor
             return
         raise RuntimeError("remote actor sends go through submit_actor_task")
 
@@ -208,19 +208,20 @@ class NodeManagerGroup:
         self._stream_item_cb = None  # (TaskID, results); set by Worker
 
         self._lock = threading.RLock()
-        self._raylets: Dict[NodeID, Raylet] = {}
-        self._remote_nodes: Dict[NodeID, RemoteNodeHandle] = {}
-        self._object_locations: Dict[ObjectID, NodeID] = {}
-        self._waiting: Dict[TaskID, TaskSpec] = {}
-        self._to_schedule: deque = deque()
-        self._infeasible: Dict[TaskID, TaskSpec] = {}
-        self._running: Dict[TaskID, RunningTask] = {}
-        self._actor_workers: Dict[ActorID, Tuple[NodeID, BaseWorker, dict]] = {}
+        self._raylets: Dict[NodeID, Raylet] = {}  # guarded-by: _lock
+        self._remote_nodes: Dict[NodeID, RemoteNodeHandle] = {}  # guarded-by: _lock
+        self._object_locations: Dict[ObjectID, NodeID] = {}  # guarded-by: _lock
+        self._waiting: Dict[TaskID, TaskSpec] = {}  # guarded-by: _lock
+        self._to_schedule: deque = deque()  # guarded-by: _lock
+        self._infeasible: Dict[TaskID, TaskSpec] = {}  # guarded-by: _lock
+        self._running: Dict[TaskID, RunningTask] = {}  # guarded-by: _lock
+        self._actor_workers: Dict[ActorID, Tuple[NodeID, BaseWorker, dict]] = {}  # guarded-by: _lock
         self._actor_death_cb: Optional[Callable] = None
 
         self._wake = threading.Event()
         self._shutdown = False
-        self._membership_version = 0   # bumped on node add/remove
+        # bumped on node add/remove
+        self._membership_version = 0  # guarded-by: _lock
 
         from ray_tpu._private.connection_hub import ConnectionHub
         self.hub = ConnectionHub(session)
@@ -256,7 +257,12 @@ class NodeManagerGroup:
         with self._lock:
             self._raylets[node_id] = raylet
         self.cluster_resources.add_or_update_node(node_id, resources)
-        self._membership_version += 1
+        with self._lock:
+            # AFTER the ledger update: the scheduler treats a version
+            # bump as "new capacity may exist" and requeues infeasible
+            # tasks exactly once — bumping first would let it consume
+            # the bump against the stale view and strand them.
+            self._membership_version += 1
         from ray_tpu._private import export
         export.emit("NODE", {"event": "ADDED", "node_id": node_id.hex(),
                              "resources": dict(resources.total)})
@@ -307,7 +313,9 @@ class NodeManagerGroup:
         with self._lock:
             self._remote_nodes[node_id] = handle
         self.cluster_resources.add_or_update_node(node_id, resources)
-        self._membership_version += 1
+        with self._lock:
+            # after the ledger update — see add_node
+            self._membership_version += 1
         from ray_tpu._private import export
         export.emit("NODE", {"event": "ADDED", "node_id": node_id.hex(),
                              "resources": dict(resources.total)})
@@ -406,8 +414,10 @@ class NodeManagerGroup:
             self._dispatch_remote(handle, specs[0])
             return
         sendable: List[Tuple[TaskSpec, dict]] = []
+        batch_shipped: set = set()
         for spec in specs:
-            payload, err = self._build_remote_payload(handle, spec)
+            payload, err = self._build_remote_payload(
+                handle, spec, batch_shipped=batch_shipped)
             if err is not None:
                 self._handle_remote_build_error(handle, spec, err)
                 continue
@@ -442,14 +452,17 @@ class NodeManagerGroup:
             return
         from ray_tpu._private import events
         requeued = False
-        for (spec, _p), status in zip(sendable, statuses):
+        accepted: List[dict] = []
+        for (spec, payload), status in zip(sendable, statuses):
             if status == "refused":
                 self._requeue_remote(handle, spec)
                 requeued = True
             else:
+                accepted.append(payload)
                 events.record(spec.task_id.hex(), spec.repr_name(),
                               "RUNNING",
                               worker=f"node:{handle.node_id.hex()[:8]}")
+        self._record_shipped_functions(handle, accepted)
         if requeued:
             self._wake.set()
 
@@ -490,15 +503,20 @@ class NodeManagerGroup:
             self._requeue_remote(handle, spec)
             self._wake.set()
             return
+        self._record_shipped_functions(handle, [payload])
         from ray_tpu._private import events
         events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
                       worker=f"node:{handle.node_id.hex()[:8]}")
 
     def _build_remote_payload(self, handle: RemoteNodeHandle,
-                              spec: TaskSpec):
+                              spec: TaskSpec,
+                              batch_shipped: Optional[set] = None):
         """Args for a remote node: inline values travel as bytes;
         object args travel as ("pull", oid, holder_addr, size) —
-        the raylet fetches them over the transfer plane."""
+        the raylet fetches them over the transfer plane.
+        ``batch_shipped``: fids whose blob an earlier payload of the
+        SAME submit_many frame already carries — one copy per frame,
+        not one per task (the raylet caches it pre-admission)."""
         arg_descs = []
         for arg in spec.args:
             if arg.object_id is None:
@@ -566,10 +584,27 @@ class NodeManagerGroup:
                 # goes away (detached lifetime).
                 payload["detached"] = True
         fid = spec.function.function_id
-        if fid not in handle.known_functions:
+        if fid not in handle.known_functions \
+                and (batch_shipped is None or fid not in batch_shipped):
             payload["function_blob"] = self._function_blob(fid)
-            handle.known_functions.add(fid)
+            if batch_shipped is not None:
+                batch_shipped.add(fid)
+            # NOT recorded in handle.known_functions here: the submit
+            # outcome is unknown — recording before a refusal/timeout
+            # would strip the blob from the task's re-send and every
+            # later task on this raylet, which then fails "unknown
+            # function". Callers record via _record_shipped_functions
+            # after a non-refused ok status.
         return payload, None
+
+    @staticmethod
+    def _record_shipped_functions(handle: RemoteNodeHandle,
+                                  accepted: List[dict]) -> None:
+        """The raylet admitted these payloads: their function blobs
+        are now cached there, so later payloads may omit them."""
+        for payload in accepted:
+            if "function_blob" in payload:
+                handle.known_functions.add(payload["function_id"])
 
     # -- remote completion routing -----------------------------------------
 
@@ -693,7 +728,7 @@ class NodeManagerGroup:
         try:
             handle.client.close()
         except Exception:
-            pass
+            pass    # connection already torn down
         self._wake.set()
 
     def remove_remote_node(self, node_id: NodeID, kill_process: bool = True
@@ -708,7 +743,7 @@ class NodeManagerGroup:
             try:
                 proc.terminate()
             except Exception:
-                pass
+                pass    # process already exited
 
     # -- submission --------------------------------------------------------
 
@@ -772,7 +807,8 @@ class NodeManagerGroup:
                 worker.send(("actor_tmpl", actor_id.binary(), tmpl))
                 worker.actor_tmpl = actor_id.binary()
             except Exception:
-                pass
+                pass    # template is an optimization: calls still
+                        # work untemplated if the send raced a death
 
     def set_actor_death_callback(self, cb: Callable) -> None:
         self._actor_death_cb = cb
@@ -1179,14 +1215,23 @@ class NodeManagerGroup:
             except Exception:
                 logger.exception("scheduling loop error")
 
-    def cancel_pipelined(self, task_id: TaskID) -> bool:
+    def cancel_pipelined(self, task_id: TaskID,
+                         force: bool = False) -> bool:
         """Cancel a task queued on a busy worker's pipe (lease
         pipelining): it is in ``_running`` (so ``cancel_queued``
         misses it) but not executing (so the targeted SIGINT would
         miss too). A targeted steal pulls it back; the stolen-reply
         handler sees the cancel flag and completes it as cancelled.
         Returns False when the task is not in a pipelined queue
-        position (caller falls through to the interrupt path)."""
+        position (caller falls through to the interrupt path).
+
+        The steal can MISS: the task sits in the owner's per-tick
+        exec_batch buffer (or in the pipe) and the steal frame beats
+        the exec frame to the worker. Two guards close that race: the
+        worker records missed steal targets and drops a later-arriving
+        exec for them (replying stolen), and the target is remembered
+        here so ``_on_tasks_stolen`` falls through to the interrupt
+        path when the reply omits it (ADVICE r5)."""
         with self._lock:
             rt = self._running.get(task_id)
             if rt is None:
@@ -1196,10 +1241,15 @@ class NodeManagerGroup:
             if not pipeq or task_id not in pipeq \
                     or pipeq[0] == task_id:
                 return False   # executing (head) or not pipe-queued
+            worker.cancel_steal_targets[task_id] = force
         try:
-            worker.send(("steal", [task_id.binary()]))
+            # True: cancel steal — the worker records a miss STICKY so
+            # an exec frame delayed arbitrarily long is still dropped
+            worker.send(("steal", [task_id.binary()], True))
             return True
         except Exception:
+            with self._lock:
+                worker.cancel_steal_targets.pop(task_id, None)
             return False
 
     # How long a pipelined task may sit queued behind a worker's
@@ -1225,23 +1275,57 @@ class NodeManagerGroup:
                             or now - w.last_activity
                             < self.PIPELINE_STALL_S):
                         continue
-                    victims = [t.binary() for t in list(w.pipeq)[1:]]
+                    victim_ids = list(w.pipeq)[1:]
+                    victims = [t.binary() for t in victim_ids]
                     w.steal_pending = True
+                    w.rescue_steal_ids = set(victim_ids)
                 try:
                     w.send(("steal", victims))
                 except Exception:
                     with self._lock:
                         w.steal_pending = False
+                        w.rescue_steal_ids = set()
 
     def _on_tasks_stolen(self, worker: BaseWorker,
-                         task_ids: List[bytes]) -> None:
+                         task_ids: List[bytes],
+                         covered: Optional[List[bytes]] = None) -> None:
         """Worker returned still-queued pipelined payloads: free their
-        slots on that worker and put them back through scheduling."""
+        slots on that worker and put them back through scheduling.
+        ``covered`` is the id set this reply answers (the steal
+        request's wanted list); None means legacy shape — treat every
+        target as covered."""
         requeue: List[TaskSpec] = []
         cancelled: List[TaskSpec] = []
         freed = []
+        interrupt: List[Tuple[TaskID, bool]] = []
         with self._lock:
-            worker.steal_pending = False
+            returned = {TaskID(b) for b in task_ids}
+            covered_set = (returned if covered is None
+                           else {TaskID(b) for b in covered})
+            # Unlatch the rescue steal only when THIS reply answers it
+            # — an unsolicited late-drop reply clearing the flag would
+            # let the rescue loop issue overlapping steals.
+            if covered is None or covered_set & worker.rescue_steal_ids:
+                worker.steal_pending = False
+                worker.rescue_steal_ids = set()
+            # Cancel-steal targets this reply ANSWERS but did not take:
+            # trusting the miss would let a cancelled task run its side
+            # effects (ADVICE r5). Two cases: the task is EXECUTING
+            # (pipe head) — fall through to the interrupt path; or its
+            # exec frame is still in transit — the worker's
+            # pending-steal intake drops it on arrival and answers
+            # stolen, so no interrupt is needed (and a force interrupt
+            # here would kill a worker mid-someone-else's task).
+            # Targets NOT covered by this reply (their own steal is
+            # still in flight) stay registered for their own reply.
+            for tid, frc in list(worker.cancel_steal_targets.items()):
+                if tid not in covered_set:
+                    continue
+                worker.cancel_steal_targets.pop(tid, None)
+                if tid not in returned and tid in self._running \
+                        and self._running[tid].worker is worker \
+                        and worker.pipeq and worker.pipeq[0] == tid:
+                    interrupt.append((tid, frc))
             for tid_b in task_ids:
                 task_id = TaskID(tid_b)
                 rt = self._running.pop(task_id, None)
@@ -1278,14 +1362,35 @@ class NodeManagerGroup:
             with self._lock:
                 self._to_schedule.extend(requeue)
             self._wake.set()
+        for tid, frc in interrupt:
+            self.interrupt_running(tid, frc)
+
+    # Per-node, per-resource cap on a non-CPU key's contribution to
+    # the slot estimate: one lane ≈ one placement, but a huge custom
+    # pool (e.g. "requests": 1e6) must not turn the estimate into the
+    # whole backlog. The schedule batch is clipped by
+    # tpu_scheduler_batch_size anyway.
+    _SLOT_ESTIMATE_LANE_CAP = 32.0
 
     def _free_slot_estimate(self) -> int:
-        """~How many queued tasks could place this tick: total free CPU
-        plus headroom so zero-CPU / custom-resource tasks and
-        infeasibility detection always make progress."""
+        """~How many queued tasks could place this tick: free CPU plus
+        free non-CPU lanes (TPU / custom resources — zero-CPU tasks
+        place against those, and counting CPU only throttled them to
+        the headroom constant under CPU saturation), plus headroom so
+        infeasibility detection always makes progress."""
         free = 0.0
         for _nid, node in self.cluster_resources.nodes():
-            free += max(0.0, node.available.get("CPU", 0.0))
+            # list(): .available is the live dict, mutated by
+            # completion threads — bare iteration can raise
+            # "dict changed size" mid-tick
+            for key, avail in list(node.available.items()):
+                if "memory" in key:
+                    continue    # byte-denominated: not a task lane
+                if key == "CPU":
+                    free += max(0.0, avail)
+                else:
+                    free += min(max(0.0, avail),
+                                self._SLOT_ESTIMATE_LANE_CAP)
         return int(free) + 8
 
     def _free_allocation(self, node_id: NodeID, resources: Dict[str, float],
@@ -1727,7 +1832,8 @@ class NodeManagerGroup:
                 evt.set()
             return
         if op == "stolen":
-            self._on_tasks_stolen(worker, reply[1])
+            self._on_tasks_stolen(worker, reply[1],
+                                  reply[2] if len(reply) > 2 else None)
             return
         if op == "stacks":
             from ray_tpu._private.profiling import deliver_stack_reply
@@ -1880,7 +1986,7 @@ class NodeManagerGroup:
                 try:
                     handle.client.call("shutdown", timeout=2)
                 except Exception:
-                    pass
+                    pass    # raylet already down: proceed to close
             handle.client.close()
             if handle.proc is not None:
                 try:

@@ -89,7 +89,8 @@ class ObjectRef:
         try:
             _on_ref_deleted(self)
         except Exception:
-            pass
+            pass    # __del__ during interpreter teardown: the
+                    # counter (and process) is going away anyway
 
     def __reduce__(self):
         # Capturing a ref inside a serialized value => borrow.

@@ -129,15 +129,18 @@ class ShmStore:
         self._spill_threshold = spill_threshold
         self._spill_dir = spill_dir
         self._lock = threading.Lock()
-        self._segments: Dict[ObjectID, shared_memory.SharedMemory] = {}
-        self._sizes: Dict[ObjectID, int] = {}
-        self._sealed: "OrderedDict[ObjectID, float]" = OrderedDict()  # LRU
-        self._spilled: Dict[ObjectID, Tuple[str, int]] = {}  # path, size
-        self._used = 0
-        self._zombies: List[shared_memory.SharedMemory] = []
+        self._segments: Dict[ObjectID, shared_memory.SharedMemory] = {}  # guarded-by: _lock
+        self._sizes: Dict[ObjectID, int] = {}  # guarded-by: _lock
+        # LRU order
+        self._sealed: "OrderedDict[ObjectID, float]" = OrderedDict()  # guarded-by: _lock
+        # path, size
+        self._spilled: Dict[ObjectID, Tuple[str, int]] = {}  # guarded-by: _lock
+        self._used = 0  # guarded-by: _lock
+        self._zombies: List[shared_memory.SharedMemory] = []  # guarded-by: _lock
         self.num_spilled = 0
         self.num_restored = 0
 
+    # lock-held: _lock
     def _close_or_defer(self, seg: shared_memory.SharedMemory) -> None:
         """Close a segment's mapping; if zero-copy views still alias it
         (BufferError: exported pointers), defer — the unlinked mapping
@@ -148,7 +151,7 @@ class ShmStore:
         except BufferError:
             self._zombies.append(seg)
 
-    def _drain_zombies(self) -> None:
+    def _drain_zombies(self) -> None:  # lock-held: _lock
         still = []
         for seg in self._zombies:
             try:
@@ -236,7 +239,7 @@ class ShmStore:
         with self._lock:
             self._free_locked(object_id)
 
-    def _free_locked(self, object_id: ObjectID) -> None:
+    def _free_locked(self, object_id: ObjectID) -> None:  # lock-held: _lock
         seg = self._segments.pop(object_id, None)
         if seg is not None:
             size = self._sizes.pop(object_id)
@@ -265,8 +268,7 @@ class ShmStore:
 
     # -- spilling ----------------------------------------------------------
 
-    def _ensure_capacity(self, incoming: int) -> None:
-        # Called with lock held.
+    def _ensure_capacity(self, incoming: int) -> None:  # lock-held: _lock
         if incoming > self._capacity:
             raise ObjectStoreFullError(
                 f"object of {incoming} bytes exceeds store capacity "
@@ -285,7 +287,7 @@ class ShmStore:
         os.makedirs(d, exist_ok=True)
         return os.path.join(d, object_id.hex())
 
-    def _spill_locked(self, object_id: ObjectID) -> None:
+    def _spill_locked(self, object_id: ObjectID) -> None:  # lock-held: _lock
         seg = self._segments.pop(object_id)
         size = self._sizes.pop(object_id)
         self._sealed.pop(object_id)
@@ -341,7 +343,7 @@ class ShmClient:
 
     def __init__(self, session: str):
         self._session = session
-        self._attached: Dict[str, shared_memory.SharedMemory] = {}
+        self._attached: Dict[str, shared_memory.SharedMemory] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def read(self, segment_name: str, size: int) -> memoryview:
@@ -365,7 +367,8 @@ class ShmClient:
                 try:
                     seg.close()
                 except (BufferError, Exception):
-                    pass
+                    pass    # exported views may pin the mapping; the
+                            # kernel reclaims it with the process
             self._attached.clear()
 
 
@@ -377,7 +380,7 @@ class MemoryStore:
     """
 
     def __init__(self):
-        self._store: Dict[ObjectID, object] = {}
+        self._store: Dict[ObjectID, object] = {}  # guarded-by: _cv
         self._cv = threading.Condition()
 
     def put(self, object_id: ObjectID, value: object) -> None:

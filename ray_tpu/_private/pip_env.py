@@ -25,11 +25,14 @@ from __future__ import annotations
 import fcntl
 import hashlib
 import json
+import logging
 import os
 import subprocess
 import sys
 import threading
 from typing import Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 _VENV_ROOT = "/tmp/rtpu_venvs"
 _BUILD_TIMEOUT_S = 600
@@ -211,4 +214,7 @@ class PipEnvManager:
         try:
             self._on_requeue(parked)
         except Exception:
-            pass
+            # a failed requeue strands every task parked on this env —
+            # loud log so the hang is diagnosable
+            logger.exception("pip-env requeue callback failed; %d "
+                             "parked task(s) stranded", len(parked))

@@ -88,7 +88,7 @@ def request_worker_stacks(workers, timeout: float = 3.0
                     w.send(("dump_stacks",))
                 asked.append(w)
             except Exception:
-                pass
+                pass    # worker died mid-request: report the rest
         out: Dict[str, str] = {}
         deadline = time.monotonic() + timeout
         for w in asked:

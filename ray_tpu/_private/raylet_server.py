@@ -127,6 +127,7 @@ class RayletServer:
         self.server.register("adjust_pool", self._handle_adjust_pool)
         self.server.register("shutdown", lambda ctx: self._request_shutdown())
         self.server.on_disconnect(self._on_conn_disconnect)
+        self.rpc_methods = self.server.registered_methods  # introspection hook
 
         self._dispatch_thread = threading.Thread(
             target=self._dispatch_loop, daemon=True, name="rtpu-raylet-disp")
@@ -228,7 +229,7 @@ class RayletServer:
                 self.gcs.update_actor_state(
                     ActorID(actor_id), "DEAD", death_cause=cause)
             except Exception:
-                pass
+                pass    # GCS unreachable: health checks converge it
 
     def _reap_actor(self, actor_id: bytes, cause: str) -> None:
         with self._lock:
@@ -238,7 +239,7 @@ class RayletServer:
             try:
                 worker.send(("shutdown",))
             except Exception:
-                pass
+                pass    # pipe broken: the kill below still lands
             worker.kill()
             self.worker_pool.remove_worker(worker)
         self._forget_actor(actor_id, cause)
@@ -264,13 +265,17 @@ class RayletServer:
         return statuses
 
     def _admit_payload(self, ctx: ConnectionContext, payload: dict) -> str:
+        # Cache the function blob BEFORE the admission check: within a
+        # submit_many frame only the first payload of a function
+        # carries the blob, and refusing that one must not strand its
+        # admitted blob-less siblings on an unknown function.
+        blob = payload.pop("function_blob", None)
+        if blob is not None:
+            self._functions[payload["function_id"]] = blob
         demand = payload.get("resources") or {}
         for name, need in demand.items():
             if need > self.resources_total.get(name, 0.0) + 1e-9:
                 return "refused"
-        blob = payload.pop("function_blob", None)
-        if blob is not None:
-            self._functions[payload["function_id"]] = blob
         with self._lock:
             self._task_ctx[payload["task_id"]] = ctx
             if payload["type"] == "create_actor":
@@ -337,7 +342,7 @@ class RayletServer:
                 write_cancel_target(self.session, pid, task_id)
                 os.kill(pid, _signal.SIGINT)
         except Exception:
-            pass
+            pass    # worker exited first: cancellation is moot
 
     def _handle_kill_actor(self, ctx: ConnectionContext,
                            actor_id: bytes) -> None:
@@ -354,7 +359,7 @@ class RayletServer:
             try:
                 worker.send(("cancel_actor_task", actor_id, task_id))
             except Exception:
-                pass
+                pass    # actor worker died: the call dies with it
 
     def _handle_dump_stacks(self, ctx) -> dict:
         """On-demand host profiling (reference: the dashboard
@@ -638,7 +643,7 @@ class RayletServer:
                 try:
                     worker.send(("shutdown",))
                 except Exception:
-                    pass
+                    pass    # pipe broken: worker is already dying
                 if orphaned:
                     return   # nobody left to tell
             self._push_owner("actor_ready", {
@@ -699,7 +704,7 @@ class RayletServer:
                                           self.available_resources(),
                                           stats=self._metric_stats())
             except Exception:
-                pass
+                pass    # transient GCS outage: next beat retries
 
     def _metric_stats(self) -> dict:
         """Small per-node stats dict shipped with each heartbeat; the

@@ -302,6 +302,12 @@ class RpcServer:
     def register(self, name: str, fn: Callable) -> None:
         self._handlers[name] = fn
 
+    def registered_methods(self) -> Tuple[str, ...]:
+        """The live handler table, sorted — the runtime half of the
+        rpc-surface static check (graftcheck cross-references the
+        statically scanned registrations against this)."""
+        return tuple(sorted(self._handlers))
+
     def on_disconnect(self, cb: Callable[[ConnectionContext], None]) -> None:
         self._disconnect_cb = cb
 
@@ -346,7 +352,7 @@ class RpcServer:
             self._server.shutdown()
             self._server.server_close()
         except Exception:
-            pass
+            pass    # double-shutdown / already-closed socket
         # socketserver.shutdown only stops the accept loop; live
         # per-connection threads keep serving until their socket dies.
         # Close them so clients see EOF and this server truly stops.
@@ -492,7 +498,7 @@ class RpcClient:
         try:
             self._sock.close()
         except Exception:
-            pass
+            pass    # already closed by the reader on EOF
 
 
 def wait_for_server(address: Tuple[str, int], timeout: float = 10.0) -> None:

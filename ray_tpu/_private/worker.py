@@ -119,7 +119,7 @@ class Worker:
         self.session = os.urandom(4).hex()
         self.job_id = JobID.from_int(1)
         self.driver_task_id = TaskID.for_driver(self.job_id)
-        self._put_index = 0
+        self._put_index = 0  # guarded-by: _counter_lock
         self._counter_lock = threading.Lock()
 
         # Session secret gating every RPC connection (rpc.py handshake).
@@ -175,7 +175,8 @@ class Worker:
         else:
             self.gcs = GcsLite()
 
-        self._functions: Dict[bytes, bytes] = {}   # fid -> cloudpickle blob
+        # fid -> cloudpickle blob
+        self._functions: Dict[bytes, bytes] = {}  # guarded-by: _functions_lock
         self._functions_lock = threading.Lock()
 
         if num_cpus is None:
@@ -239,7 +240,7 @@ class Worker:
         # any other completion hook) — fired inline on the completion
         # path, so no per-ref waiter threads
         self._ready_cb_lock = threading.Lock()
-        self._ready_callbacks: Dict[ObjectID, List] = {}
+        self._ready_callbacks: Dict[ObjectID, List] = {}  # guarded-by: _ready_cb_lock
         self.gcs.publisher.subscribe("RESOURCES", self._on_resource_report)
 
         # per-actor ordered submission queues; _actor_flush_locks
@@ -249,11 +250,12 @@ class Worker:
         # .remote() loop runs ahead of the wire and calls accumulate
         # into real batches (one frame per flush, not per call).
         self._actor_lock = threading.RLock()
-        self._actor_queues: Dict[ActorID, deque] = {}
-        self._actor_seq: Dict[ActorID, int] = {}
-        self._actor_specs: Dict[ActorID, TaskSpec] = {}   # creation specs
-        self._actor_restarts: Dict[ActorID, int] = {}
-        self._actor_flush_locks: Dict[ActorID, threading.RLock] = {}
+        self._actor_queues: Dict[ActorID, deque] = {}  # guarded-by: _actor_lock
+        self._actor_seq: Dict[ActorID, int] = {}  # guarded-by: _actor_lock
+        # creation specs
+        self._actor_specs: Dict[ActorID, TaskSpec] = {}  # guarded-by: _actor_lock
+        self._actor_restarts: Dict[ActorID, int] = {}  # guarded-by: _actor_lock
+        self._actor_flush_locks: Dict[ActorID, threading.RLock] = {}  # guarded-by: _actor_lock
         self._actor_flush_wake = threading.Event()
         self._actor_flusher = threading.Thread(
             target=self._actor_flush_loop, daemon=True,
@@ -931,14 +933,14 @@ class Worker:
             try:
                 handle.client.oneway("adjust_pool", 1)
             except Exception:
-                pass
+                pass    # node lost: its pool no longer matters
 
             def release():
                 _reacquire()
                 try:
                     handle.client.oneway("adjust_pool", -1)
                 except Exception:
-                    pass
+                    pass    # node lost: its pool no longer matters
             return release
         return _reacquire
 
@@ -1651,7 +1653,8 @@ class Worker:
                 # so the kill reaches the worker, not just the tables.
                 self._ensure_actor_route(actor_id, info)
             except Exception:
-                pass
+                pass    # hosting raylet unreachable: state update
+                        # below still marks the actor dead
         with self._actor_lock:
             self._actor_restarts[actor_id] = 0
         self.node_group.release_actor(actor_id, kill_worker=True)
@@ -1701,7 +1704,7 @@ class Worker:
                         self.gcs.update_actor_state(
                             actor_id, "DEAD", death_cause="driver exited")
                 except Exception:
-                    pass
+                    pass    # shutdown path: best-effort teardown
         self.node_group.shutdown(leave_remote_nodes=joined)
         self.shm_store.shutdown()
         self.device_store.shutdown()
@@ -1709,19 +1712,19 @@ class Worker:
             try:
                 self.gcs.close()
             except Exception:
-                pass
+                pass    # connection already dropped
             try:
                 self._gcs_proc.terminate()
                 self._gcs_proc.wait(timeout=5)
             except Exception:
-                pass
+                pass    # GCS process already exited
             self._gcs_proc = None
         elif self._join_address is not None:
             # joined cluster: leave the shared GCS running
             try:
                 self.gcs.close()
             except Exception:
-                pass
+                pass    # connection already dropped
         from ray_tpu._private import export as _export
         try:
             tm = self.task_manager
@@ -1740,7 +1743,7 @@ class Worker:
                     "actors_registered": len(self._actor_specs),
                 })
         except Exception:
-            pass
+            pass    # exporter already stopped: stats are optional
         _export.stop()
         if self._join_address is None:
             # Session owner: sweep shm orphans left by killed workers.
@@ -1810,7 +1813,7 @@ class Worker:
                     f"task {rec.spec.repr_name()} was cancelled before "
                     "it started"))
             return
-        if self.node_group.cancel_pipelined(task_id):
+        if self.node_group.cancel_pipelined(task_id, force):
             # queued on a busy worker's pipe: a targeted steal pulls
             # it back and the stolen-reply handler (which re-checks the
             # cancel flag) completes it as cancelled — the SIGINT

@@ -245,13 +245,13 @@ class WorkerCore:
             try:
                 seg.unlink()
             except Exception:
-                pass
+                pass    # segment name already gone
             try:
                 seg.close()
             except BufferError:
                 self._zombies.append(seg)
             except Exception:
-                pass
+                pass    # close raced the segment's removal
 
     # -- peer-facing handlers ------------------------------------------
 
@@ -337,7 +337,7 @@ class WorkerCore:
             try:
                 seg.close()
             except Exception:
-                pass
+                pass    # still-pinned view: process exit reclaims
         self._zombies.clear()
         self.server.shutdown()
 

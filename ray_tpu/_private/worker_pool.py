@@ -47,6 +47,14 @@ class BaseWorker:
         self.pipeq: "deque" = deque()
         self.last_activity = time.monotonic()
         self.steal_pending = False
+        # ids the in-flight rescue steal asked for: steal_pending is
+        # cleared only by a reply covering these (an unsolicited
+        # late-drop stolen reply must not unlatch an in-flight rescue)
+        self.rescue_steal_ids: set = set()
+        # targeted cancel steals in flight (task_id -> force): when the
+        # stolen reply omits one, the owner falls through to the
+        # interrupt path instead of trusting the miss (steal/exec race)
+        self.cancel_steal_targets: dict = {}
 
     def send(self, msg: tuple) -> None:
         raise NotImplementedError
@@ -123,12 +131,12 @@ class ProcessWorker(BaseWorker):
         try:
             self.proc.terminate()
         except Exception:
-            pass
+            pass    # process already exited
         if self.conn is not None:
             try:
                 self.conn.close()
             except Exception:
-                pass
+                pass    # pipe already closed by the IO thread
 
 
 class InProcessWorker(BaseWorker):
@@ -219,11 +227,11 @@ class WorkerPool:
         self._on_worker_ready = on_worker_ready
         self._max_process = max_process_workers
         self._max_inproc = max_inproc_workers
-        self._idle_process: List[ProcessWorker] = []
+        self._idle_process: List[ProcessWorker] = []  # guarded-by: _lock
         # pip-runtime-env workers, idle, keyed by env tag (venv hash)
-        self._idle_tagged: Dict[str, List[ProcessWorker]] = {}
-        self._idle_inproc: List[InProcessWorker] = []
-        self._all: Dict[WorkerID, BaseWorker] = {}
+        self._idle_tagged: Dict[str, List[ProcessWorker]] = {}  # guarded-by: _lock
+        self._idle_inproc: List[InProcessWorker] = []  # guarded-by: _lock
+        self._all: Dict[WorkerID, BaseWorker] = {}  # guarded-by: _lock
         self._lock = threading.RLock()
 
     # -- substrate choice --------------------------------------------------
@@ -288,6 +296,7 @@ class WorkerPool:
             self._all[pw.worker_id] = pw
             return None
 
+    # lock-held: _lock
     def _evict_idle_mismatch(self, want_tag: Optional[str]) -> bool:
         """At the process cap, kill ONE idle worker whose env doesn't
         match the requested lease so the cap can admit the right kind
@@ -311,7 +320,7 @@ class WorkerPool:
         try:
             victim.send(("shutdown",))
         except Exception:
-            pass
+            pass    # broken pipe: the kill below still lands
         victim.kill()
         return True
 
@@ -325,8 +334,7 @@ class WorkerPool:
                     self._idle_process.append(worker)
         self._on_worker_ready()
 
-    def _reap_dead(self) -> None:
-        # lock held
+    def _reap_dead(self) -> None:  # lock-held: _lock
         cfg = get_config()
         now = time.monotonic()
         for w in list(self._all.values()):
@@ -347,7 +355,7 @@ class WorkerPool:
             try:
                 oldest.send(("shutdown",))
             except Exception:
-                pass
+                pass    # broken pipe: the kill below still lands
             oldest.kill()
         # pip-env workers: reap ALL past the idle deadline (no warm
         # keeper — they still count against the process cap, so idle
@@ -360,7 +368,7 @@ class WorkerPool:
                 try:
                     w.send(("shutdown",))
                 except Exception:
-                    pass
+                    pass    # broken pipe: the kill below still lands
                 w.kill()
             if not tagged:
                 del self._idle_tagged[tag]

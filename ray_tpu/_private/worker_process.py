@@ -49,6 +49,7 @@ import contextvars
 import inspect
 import os
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -453,14 +454,15 @@ class ExecutionEnv:
                     self._publish_channels(payload["publish"], blob,
                                            kind="err")
                 except Exception:
-                    pass
+                    pass    # channel consumer gone: error already
+                            # travels through the task reply
             # Failed before consuming our own channel args? Drain what
             # arrived so pushed entries / producer segments don't leak.
             try:
                 from ray_tpu._private import worker_core
                 worker_core.drain_channel_args(payload.get("args"))
             except Exception:
-                pass
+                pass    # drain is itself best-effort leak hygiene
             if payload["type"] == "create_actor":
                 return ("actor_ready", payload["actor_id"], blob)
             return ("done", task_id, [], blob,
@@ -542,7 +544,8 @@ class ExecutionEnv:
                     self._publish_channels(payload["publish"], blob,
                                            kind="err")
                 except Exception:
-                    pass
+                    pass    # channel consumer gone: error already
+                            # travels through the task reply
             return ("done", task_id, [], blob,
                     {"exec_ms": 1e3 * (_time.perf_counter() - t_start)})
 
@@ -729,7 +732,7 @@ class _AsyncActorLoop:
                     self.loop.run_until_complete(
                         asyncio.gather(*tasks, return_exceptions=True))
             except Exception:
-                pass
+                pass    # loop already closing: cancellation is moot
             self.loop.close()
 
     def submit(self, payload: dict, send: Callable[[tuple], None]) -> None:
@@ -933,6 +936,40 @@ def worker_main(conn, session: str, max_inline_bytes: int,
     inbox_lock = threading.Lock()
     inbox_evt = threading.Event()
     conn_closed = [False]
+    # Steal targets the intake could NOT find (task_id -> deadline):
+    # the steal frame beat the exec frame onto the pipe (the owner's
+    # per-tick exec_batch buffer had not flushed yet). When the exec
+    # finally lands, drop it and answer stolen — a cancelled pipelined
+    # task must NEVER run. Rescue-steal entries expire: a miss can
+    # also mean the task was already executing (it completes
+    # normally), and a rescued task may legitimately be re-dispatched
+    # here later. CANCEL-steal entries (deadline None) never expire —
+    # a cancelled task id is never legitimately re-sent, and expiry
+    # would re-open the race for an exec frame delayed past the TTL;
+    # a size cap bounds the pathological-miss case instead.
+    pending_steal: dict = {}
+    PENDING_STEAL_TTL_S = 10.0
+    PENDING_STEAL_STICKY_CAP = 256
+    # pop() default distinguishable from the sticky entries' None VALUE
+    # — `pop(tid, None) is not None` would read every sticky entry as
+    # absent and silently destroy it
+    _PENDING_MISSING = object()
+
+    def _expire_pending_steals() -> None:
+        # inbox_lock held
+        now = time.monotonic()
+        for tid in [t for t, dl in pending_steal.items()
+                    if dl is not None and dl < now]:
+            del pending_steal[tid]
+        sticky = [t for t, dl in pending_steal.items() if dl is None]
+        for tid in sticky[:-PENDING_STEAL_STICKY_CAP]:
+            del pending_steal[tid]    # oldest first (insertion order)
+
+    def _intercept_stolen_exec(payload: dict) -> bool:
+        # inbox_lock held; True -> payload consumed (answer stolen)
+        _expire_pending_steals()
+        return (pending_steal.pop(payload["task_id"], _PENDING_MISSING)
+                is not _PENDING_MISSING)
 
     def _intake() -> None:
         while True:
@@ -945,6 +982,9 @@ def worker_main(conn, session: str, max_inline_bytes: int,
             op0 = msg[0]
             if op0 == "steal":
                 wanted = set(msg[1])
+                # third element marks a targeted CANCEL steal: its
+                # misses are recorded sticky (no TTL)
+                is_cancel = len(msg) > 2 and msg[2]
                 taken = []
                 with inbox_lock:
                     kept = []
@@ -955,8 +995,22 @@ def worker_main(conn, session: str, max_inline_bytes: int,
                             kept.append(m)
                     inbox.clear()
                     inbox.extend(kept)
+                    deadline = (None if is_cancel else
+                                time.monotonic() + PENDING_STEAL_TTL_S)
+                    for tid in wanted:
+                        if tid in taken:
+                            continue
+                        if pending_steal.get(tid, 0) is None:
+                            continue    # never downgrade a sticky
+                                        # cancel entry to a TTL one
+                        pending_steal[tid] = deadline
                 try:
-                    send(("stolen", taken))
+                    # third element: the ids this reply COVERS — the
+                    # owner sweeps its cancel-steal targets only for
+                    # requests actually answered (a reply to an earlier
+                    # unrelated steal must not pop a target whose own
+                    # steal is still in flight)
+                    send(("stolen", taken, list(wanted)))
                 except Exception:
                     return
                 continue
@@ -967,15 +1021,31 @@ def worker_main(conn, session: str, max_inline_bytes: int,
                 try:
                     env.cancel_actor_task(msg[1], msg[2])
                 except Exception:
-                    pass
+                    pass    # unknown/finished call: nothing to cancel
                 continue
+            stolen_late = []
             if op0 == "exec_batch":
                 # flatten so individual queued tasks stay stealable
                 with inbox_lock:
-                    inbox.extend(("exec", p) for p in msg[1])
+                    for p in msg[1]:
+                        if _intercept_stolen_exec(p):
+                            stolen_late.append(p["task_id"])
+                        else:
+                            inbox.append(("exec", p))
+            elif op0 == "exec":
+                with inbox_lock:
+                    if _intercept_stolen_exec(msg[1]):
+                        stolen_late.append(msg[1]["task_id"])
+                    else:
+                        inbox.append(msg)
             else:
                 with inbox_lock:
                     inbox.append(msg)
+            if stolen_late:
+                try:
+                    send(("stolen", stolen_late, list(stolen_late)))
+                except Exception:
+                    return
             inbox_evt.set()
 
     threading.Thread(target=_intake, daemon=True,
@@ -1046,7 +1116,7 @@ def worker_main(conn, session: str, max_inline_bytes: int,
         try:
             conn.close()
         except Exception:
-            pass
+            pass    # owner side already hung up
 
 
 def _standalone_main() -> None:

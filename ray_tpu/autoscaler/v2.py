@@ -298,7 +298,7 @@ class AutoscalerV2:
                 try:
                     self.provider.terminate(inst.cloud_id)
                 except Exception:
-                    pass
+                    pass    # instance may already be gone cloud-side
                 if inst.launch_attempts < self.max_launch_attempts:
                     logger.info("v2: %s allocation %s, requeueing "
                                 "(attempt %d)", inst.instance_id,
@@ -328,7 +328,7 @@ class AutoscalerV2:
                 try:
                     self.provider.terminate(inst.cloud_id)
                 except Exception:
-                    pass
+                    pass    # instance may already be gone cloud-side
                 inst.to(InstanceState.TERMINATED)
 
     def _terminate_idle(self) -> None:

@@ -149,6 +149,6 @@ class Cluster:
                 self._gcs_proc.terminate()
                 self._gcs_proc.wait(timeout=5)
             except Exception:
-                pass
+                pass    # GCS process already exited
             self._gcs_proc = None
             self._gcs_addr = None

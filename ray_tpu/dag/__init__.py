@@ -425,7 +425,7 @@ class CompiledDAG:
                 worker_core._peer(tuple(addr)).oneway(
                     "chan_push", oid_b, ("err", err_blob), takes)
             except Exception:
-                pass
+                pass    # consumer gone: its own failure surfaces it
 
     @staticmethod
     def _submit_with_retry(w, st: _Stage, spec, payload,

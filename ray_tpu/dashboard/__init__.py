@@ -228,7 +228,7 @@ class Dashboard:
             self._server.shutdown()
             self._server.server_close()
         except Exception:
-            pass
+            pass    # double-shutdown / already-closed socket
 
 
 _dashboard: Optional[Dashboard] = None

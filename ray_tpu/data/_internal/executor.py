@@ -264,7 +264,7 @@ def _ref_nbytes(ref) -> int:
         if entry.kind == "blob":
             return len(entry.data)
     except Exception:
-        pass
+        pass    # freed/odd-shaped entry: size is advisory
     return 0
 
 
@@ -385,7 +385,7 @@ class _MapRuntime:
             try:
                 ray_tpu.kill(a)
             except Exception:
-                pass
+                pass    # actor already dead
         self.actors = []
 
 

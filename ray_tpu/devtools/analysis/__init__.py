@@ -1,0 +1,20 @@
+"""graftcheck: the repo-native concurrency & RPC-surface static
+analysis suite. See core.py for the framework and docs/
+static_analysis.md for the conventions.
+
+Programmatic entry point::
+
+    from ray_tpu.devtools.analysis import run_analysis
+    unsuppressed, all_findings = run_analysis(["ray_tpu/"])
+
+CLI::
+
+    python -m ray_tpu.devtools.analysis ray_tpu/
+"""
+
+from ray_tpu.devtools.analysis.core import (  # noqa: F401
+    Baseline,
+    Finding,
+    default_baseline_path,
+    run_analysis,
+)

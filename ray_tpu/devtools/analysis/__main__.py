@@ -1,0 +1,85 @@
+"""CLI: ``python -m ray_tpu.devtools.analysis [paths...]``.
+
+Exit status: 0 when every finding is baseline-suppressed, 1 when
+unsuppressed findings remain, 2 on usage errors. ``--update-baseline``
+rewrites the suppression file with the current finding set (do this
+only for findings reviewed and accepted as status quo; new code should
+fix, not suppress)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ray_tpu.devtools.analysis.core import (
+    default_baseline_path,
+    run_analysis,
+)
+from ray_tpu.devtools.analysis.passes import load_passes
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.analysis",
+        description="graftcheck: concurrency & RPC-surface lint")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to scan (default: the "
+                             "ray_tpu package)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON path (default: "
+                             f"{default_baseline_path()})")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="accept the current findings into the "
+                             "baseline instead of failing on them")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="ignore and don't write the per-file "
+                             "findings cache")
+    parser.add_argument("--pass", dest="pass_ids", action="append",
+                        metavar="PASS_ID",
+                        help="run only this pass (repeatable)")
+    parser.add_argument("--list-passes", action="store_true",
+                        help="list pass ids and exit")
+    parser.add_argument("--all", action="store_true",
+                        help="print suppressed findings too")
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for p in load_passes():
+            doc = (p.__doc__ or "").strip().splitlines()[0]
+            print(f"{p.PASS_ID:18s} {doc}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        import ray_tpu
+        paths = [os.path.dirname(os.path.abspath(ray_tpu.__file__))]
+
+    try:
+        unsuppressed, all_findings = run_analysis(
+            paths,
+            baseline_path=args.baseline,
+            use_cache=not args.no_cache,
+            update_baseline=args.update_baseline,
+            pass_ids=args.pass_ids)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        print(f"baseline updated: {len(all_findings)} finding(s) "
+              f"accepted into "
+              f"{args.baseline or default_baseline_path()}")
+        return 0
+
+    shown = all_findings if args.all else unsuppressed
+    for f in shown:
+        print(f.render())
+    n_suppressed = len(all_findings) - len(unsuppressed)
+    print(f"graftcheck: {len(unsuppressed)} finding(s), "
+          f"{n_suppressed} baseline-suppressed")
+    return 1 if unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

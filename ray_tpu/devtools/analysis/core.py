@@ -1,0 +1,355 @@
+"""graftcheck core: pass-runner over ``ast`` with per-file caching and
+a JSON baseline-suppression file.
+
+The runtime under ``ray_tpu/_private`` is a layered concurrent system
+(raylet scheduling loops, worker pools, an object store, an RPC mesh);
+every class of advisor finding so far — unlocked mutations, state
+recorded before an RPC outcome is known, client/server RPC drift — is
+statically detectable. This framework turns those one-off catches into
+a permanent ratchet: five passes (see ``passes/``) run over the tree,
+unsuppressed findings fail the build (tier-1 runs the suite via
+``tests/test_static_analysis.py``).
+
+Pass protocol — a pass module exposes:
+
+- ``PASS_ID``: short kebab-case name, stable across versions.
+- ``VERSION``: int; bumping it invalidates cached findings.
+- ``check_file(ctx) -> list[Finding]``   (per-file pass, cacheable), or
+- ``check_project(ctxs) -> list[Finding]`` (cross-file pass, e.g. the
+  rpc-surface table cross-check; always re-run, never cached).
+
+Suppression is two-level: a fingerprint baseline (``baseline.json``
+next to this module, regenerated with ``--update-baseline``) for
+accepted legacy findings, and inline source conventions documented per
+pass (``# guarded-by:``, ``# lock-held:``, ``# rpc: external``).
+Fingerprints hash (pass, path, enclosing scope, message) — NOT line
+numbers — so unrelated edits above a finding don't unsuppress it.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Dict, List, Optional, Sequence
+
+CACHE_BASENAME = ".rtpu_analysis_cache.json"
+
+
+@dataclass
+class Finding:
+    pass_id: str
+    path: str          # repo-relative, '/'-separated
+    line: int
+    context: str       # "Class.method", "function", or "<module>"
+    message: str
+    # Occurrence index among same-(pass, path, context, message)
+    # findings, in line order — assigned per run by run_analysis.
+    # Without it, one baselined finding would also suppress every
+    # FUTURE identical finding in the same scope (the ratchet breaks);
+    # with it, N accepted occurrences suppress exactly the first N.
+    ordinal: int = 0
+
+    def fingerprint(self) -> str:
+        key = "|".join((self.pass_id, self.path, self.context,
+                        self.message, str(self.ordinal)))
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_id}] "
+                f"{self.context}: {self.message}")
+
+    def to_json(self) -> dict:
+        return {"pass": self.pass_id, "path": self.path,
+                "line": self.line, "context": self.context,
+                "message": self.message, "ordinal": self.ordinal,
+                "fingerprint": self.fingerprint()}
+
+    @staticmethod
+    def from_json(d: dict) -> "Finding":
+        return Finding(d["pass"], d["path"], d["line"], d["context"],
+                       d["message"], d.get("ordinal", 0))
+
+
+_COMMENT_RE = re.compile(r"#.*$")
+
+
+def attr_tail(node: ast.AST) -> Optional[str]:
+    """Final name of a Name/dotted-Attribute expression, e.g.
+    ``raylet.worker_pool._lock`` -> ``_lock``; None for anything else.
+    Shared by the passes (receiver/lock/module matching)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+@dataclass
+class FileContext:
+    """Everything a pass needs about one source file, parsed once."""
+
+    path: str                   # repo-relative
+    abspath: str
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    _comments: Optional[Dict[int, str]] = None
+
+    @property
+    def comments(self) -> Dict[int, str]:
+        """line number -> comment text (without leading '#'), via
+        tokenize so '#' inside string literals never miscounts."""
+        if self._comments is None:
+            out: Dict[int, str] = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                        StringIO(self.source).readline):
+                    if tok.type == tokenize.COMMENT:
+                        out[tok.start[0]] = tok.string.lstrip("#").strip()
+            except (tokenize.TokenError, SyntaxError, ValueError):
+                pass    # ast.parse accepted the file; comments are
+                        # best-effort annotations on top
+            self._comments = out
+        return self._comments
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Dotted enclosing scope of a node ("Class.method")."""
+        return self.scope_of_line(getattr(node, "lineno", 0))
+
+    def scope_of_line(self, target_line: int) -> str:
+        best: List[str] = []
+
+        def walk(n: ast.AST, trail: List[str]) -> None:
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.ClassDef, ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    end = getattr(child, "end_lineno", child.lineno)
+                    if child.lineno <= target_line <= end:
+                        trail.append(child.name)
+                        if len(trail) > len(best):
+                            best[:] = trail
+                        walk(child, trail)
+                        trail.pop()
+                else:
+                    walk(child, trail)
+
+        walk(self.tree, [])
+        return ".".join(best) if best else "<module>"
+
+
+def parse_file(abspath: str, root: str) -> Optional[FileContext]:
+    try:
+        with open(abspath, encoding="utf-8", errors="replace") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=abspath)
+    except (OSError, SyntaxError):
+        return None
+    rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+    return FileContext(path=rel, abspath=abspath, source=source,
+                       tree=tree, lines=source.splitlines())
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git",
+                                        "analysis_fixtures")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+class Baseline:
+    """Fingerprint suppression set, persisted as JSON. Entries keep the
+    finding's last-seen text purely for human review of the file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.entries: Dict[str, dict] = {}
+        if os.path.exists(path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    data = json.load(f)
+                for e in data.get("findings", []):
+                    self.entries[e["fingerprint"]] = e
+            except (OSError, ValueError):
+                self.entries = {}
+
+    def suppresses(self, finding: Finding) -> bool:
+        return finding.fingerprint() in self.entries
+
+    def write(self, findings: List[Finding],
+              scanned_paths: Optional[set] = None) -> None:
+        """Accept ``findings`` into the baseline. Entries for files
+        OUTSIDE ``scanned_paths`` are preserved — updating from a
+        partial scan (one file, one directory) must not silently
+        delete the suppressions the scan never looked at."""
+        entries = [f.to_json() for f in findings]
+        if scanned_paths is not None:
+            fresh = {e["fingerprint"] for e in entries}
+            entries.extend(
+                e for e in self.entries.values()
+                if e["path"] not in scanned_paths
+                and e["fingerprint"] not in fresh)
+        data = {
+            "comment": ("graftcheck baseline: accepted findings, keyed "
+                        "by fingerprint. Regenerate with `python -m "
+                        "ray_tpu.devtools.analysis --update-baseline`."),
+            "findings": sorted(entries,
+                               key=lambda d: (d["path"], d["pass"],
+                                              d["line"])),
+        }
+        with open(self.path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+
+class FileCache:
+    """Per-file findings cache for the per-file passes, keyed on
+    (mtime, size, passes-version). Cross-file passes never cache."""
+
+    def __init__(self, path: str, version_tag: str):
+        self.path = path
+        self.version_tag = version_tag
+        self.data: Dict[str, dict] = {}
+        self.dirty = False
+        if path and os.path.exists(path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    raw = json.load(f)
+                if raw.get("version_tag") == version_tag:
+                    self.data = raw.get("files", {})
+            except (OSError, ValueError):
+                pass
+
+    def _stat_key(self, abspath: str) -> Optional[List[float]]:
+        try:
+            st = os.stat(abspath)
+        except OSError:
+            return None
+        return [st.st_mtime, st.st_size]
+
+    def get(self, abspath: str) -> Optional[List[Finding]]:
+        entry = self.data.get(abspath)
+        if entry is None or entry.get("stat") != self._stat_key(abspath):
+            return None
+        return [Finding.from_json(d) for d in entry["findings"]]
+
+    def put(self, abspath: str, findings: List[Finding]) -> None:
+        stat = self._stat_key(abspath)
+        if stat is None:
+            return
+        self.data[abspath] = {"stat": stat,
+                              "findings": [f.to_json() for f in findings]}
+        self.dirty = True
+
+    def save(self) -> None:
+        if not (self.path and self.dirty):
+            return
+        try:
+            with open(self.path, "w", encoding="utf-8") as f:
+                json.dump({"version_tag": self.version_tag,
+                           "files": self.data}, f)
+        except OSError:
+            pass
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def run_analysis(paths: Sequence[str],
+                 root: Optional[str] = None,
+                 baseline_path: Optional[str] = None,
+                 use_cache: bool = True,
+                 update_baseline: bool = False,
+                 pass_ids: Optional[Sequence[str]] = None):
+    """Run the suite; returns (unsuppressed, all_findings).
+
+    ``root`` anchors repo-relative paths (and fingerprints); default is
+    the repository root inferred from this package's location.
+    """
+    from ray_tpu.devtools.analysis.passes import load_passes
+
+    passes = load_passes()
+    if pass_ids is not None:
+        if update_baseline:
+            # A restricted-pass scan sees only a slice of the findings;
+            # rewriting the baseline from it would erase every other
+            # pass's accepted suppressions.
+            raise ValueError(
+                "--update-baseline cannot be combined with --pass: "
+                "run the full suite to regenerate the baseline")
+        wanted = set(pass_ids)
+        unknown = wanted - {p.PASS_ID for p in passes}
+        if unknown:
+            raise ValueError(f"unknown pass ids: {sorted(unknown)}")
+        passes = [p for p in passes if p.PASS_ID in wanted]
+    if root is None:
+        # ray_tpu/devtools/analysis/core.py -> repo root is 3 up from
+        # the package dir
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+
+    version_tag = ",".join(
+        f"{p.PASS_ID}={getattr(p, 'VERSION', 0)}" for p in passes)
+    cache = FileCache(os.path.join(root, CACHE_BASENAME) if use_cache
+                      else "", version_tag)
+
+    file_passes = [p for p in passes if hasattr(p, "check_file")]
+    project_passes = [p for p in passes if hasattr(p, "check_project")]
+
+    # Files are always parsed (the cross-file passes need every AST);
+    # the cache only short-circuits the per-file passes, which dominate.
+    findings: List[Finding] = []
+    ctxs: List[FileContext] = []
+    for abspath in collect_files(paths):
+        ctx = parse_file(abspath, root)
+        if ctx is None:
+            continue
+        ctxs.append(ctx)
+        cached = cache.get(abspath)
+        if cached is not None:
+            findings.extend(cached)
+            continue
+        file_findings: List[Finding] = []
+        for p in file_passes:
+            file_findings.extend(p.check_file(ctx))
+        cache.put(abspath, file_findings)
+        findings.extend(file_findings)
+    for p in project_passes:
+        findings.extend(p.check_project(ctxs))
+    cache.save()
+
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
+    # Ordinals are per-run (cached findings carry stale ones): the
+    # k-th identical finding in line order gets ordinal k, so removing
+    # an earlier occurrence shifts survivors into the already-accepted
+    # range while a NEW occurrence lands beyond it, unsuppressed.
+    occurrence: Dict[tuple, int] = {}
+    for f in findings:
+        key = (f.pass_id, f.path, f.context, f.message)
+        f.ordinal = occurrence.get(key, 0)
+        occurrence[key] = f.ordinal + 1
+    baseline = Baseline(baseline_path or default_baseline_path())
+    if update_baseline:
+        baseline.write(findings,
+                       scanned_paths={c.path for c in ctxs})
+        return [], findings
+    unsuppressed = [f for f in findings if not baseline.suppresses(f)]
+    return unsuppressed, findings

@@ -1,0 +1,17 @@
+"""graftcheck pass registry. Order is the report order."""
+
+from __future__ import annotations
+
+from typing import List
+
+
+def load_passes() -> List:
+    from ray_tpu.devtools.analysis.passes import (
+        async_blocking,
+        lock_discipline,
+        ref_leak,
+        rpc_surface,
+        silent_exception,
+    )
+    return [lock_discipline, async_blocking, rpc_surface,
+            silent_exception, ref_leak]

@@ -128,4 +128,4 @@ class EnvRunnerGroup:
             try:
                 ray_tpu.kill(r)
             except Exception:
-                pass
+                pass    # runner already dead

@@ -327,7 +327,7 @@ class IMPALA:
             # fire-and-forget: runners pick the new weights up at their
             # next step boundary, wherever they are in a trajectory
             for r in self._runners:
-                r.set_params.remote(self.params, self._version)
+                _ = r.set_params.remote(self.params, self._version)
 
         mean_ret = (float(np.mean(self._recent_returns))
                     if self._recent_returns else 0.0)
@@ -363,11 +363,11 @@ class IMPALA:
         self._step_count = state["step_count"]
         self._version = state["version"]
         for r in self._runners:
-            r.set_params.remote(self.params, self._version)
+            _ = r.set_params.remote(self.params, self._version)
 
     def stop(self) -> None:
         for r in self._runners:
             try:
                 ray_tpu.kill(r)
             except Exception:
-                pass
+                pass    # runner already dead

@@ -479,4 +479,4 @@ class MultiAgentPPO:
             try:
                 ray_tpu.kill(r)
             except Exception:
-                pass
+                pass    # runner already dead

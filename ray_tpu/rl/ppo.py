@@ -326,4 +326,4 @@ class PPO:
             try:
                 remove_placement_group(self._pg)
             except Exception:
-                pass
+                pass    # group already removed with the cluster

@@ -379,7 +379,7 @@ def _follow_logs(args) -> int:
                         remote_state["sources"].append((node_hex,
                                                         client))
             except Exception:
-                pass
+                pass    # node flapped mid-poll: retry next tick
             remote_state["sources"] = [
                 (h, c) for h, c in remote_state["sources"] if c.alive]
         return remote_state["sources"]

@@ -354,7 +354,7 @@ def shutdown() -> None:
                 import ray_tpu
                 ray_tpu.kill(_worker_proxy)
             except Exception:
-                pass
+                pass    # proxy actor already dead
             _worker_proxy = None
         if _controller is not None:
             _controller.shutdown()

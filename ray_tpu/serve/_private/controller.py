@@ -96,8 +96,8 @@ class ServeController:
             infos = list(self._deployments.values())
         for info in infos:
             try:
-                proxy_handle.update_routes.remote(info.name,
-                                                  info.replica_set)
+                _ = proxy_handle.update_routes.remote(
+                    info.name, info.replica_set)
             except Exception:
                 logger.exception("proxy route push failed")
 
@@ -114,7 +114,8 @@ class ServeController:
             proxies = list(self._proxies)
         for proxy in proxies:
             try:
-                proxy.update_routes.remote(info.name, info.replica_set)
+                _ = proxy.update_routes.remote(info.name,
+                                               info.replica_set)
             except Exception:
                 logger.exception("proxy route push failed")
 
@@ -173,9 +174,9 @@ class ServeController:
                 info.replica_set.set_replicas([])
                 for proxy in proxies:
                     try:
-                        proxy.update_routes.remote(name, None)
+                        _ = proxy.update_routes.remote(name, None)
                     except Exception:
-                        pass
+                        pass    # proxy died: nothing routes there now
 
     def get_replica_set(self, name: str) -> Optional[ReplicaSet]:
         with self._lock:
@@ -432,4 +433,4 @@ class ServeController:
             try:
                 ray_tpu.kill(handle)
             except Exception:
-                pass
+                pass    # replica already dead

@@ -139,7 +139,7 @@ class HttpProxy:
             self._server.shutdown()
             self._server.server_close()
         except Exception:
-            pass
+            pass    # double-shutdown / already-closed socket
 
 
 class ProxyActor:

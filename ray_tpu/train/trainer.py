@@ -125,7 +125,7 @@ class _TrainWorker:
                 try:
                     teardown()
                 except Exception:
-                    pass
+                    pass    # user teardown must not mask the result
             shutdown_session()
 
 
@@ -365,13 +365,14 @@ class DataParallelTrainer:
                 seen, latest_ckpt = self._drain_reports(
                     report_dir, seen, history, latest_ckpt)
             except Exception:
-                pass
+                pass    # drain races the attempt's failure: keep the
+                        # error that brought us here
             self._attempt_ckpt = latest_ckpt
             for w in workers:
                 try:
                     ray_tpu.kill(w)
                 except Exception:
-                    pass
+                    pass    # worker already dead
             remove_placement_group(pg)
             shutil.rmtree(report_dir, ignore_errors=True)
 

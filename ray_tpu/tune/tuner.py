@@ -418,7 +418,7 @@ class TuneController:
             try:
                 ray_tpu.kill(trial.actor)
             except Exception:
-                pass
+                pass    # trial actor already dead
             trial.actor = None
         if trial.report_dir:
             shutil.rmtree(trial.report_dir, ignore_errors=True)

@@ -58,7 +58,8 @@ def _make_backend_cls():
                     try:
                         result.get()
                     except Exception:
-                        pass
+                        pass    # joblib re-raises via result.get() in
+                                # the callback; this just waits
                     callback(result)
 
                 threading.Thread(target=drive, daemon=True).start()

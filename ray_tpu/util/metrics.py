@@ -170,7 +170,7 @@ def prometheus_text() -> str:
         try:
             fn()
         except Exception:
-            pass
+            pass    # one bad collector must not break the scrape
     lines: List[str] = []
     with _REGISTRY_LOCK:
         metrics = list(_REGISTRY.values())

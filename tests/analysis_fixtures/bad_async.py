@@ -1,0 +1,9 @@
+"""async-blocking fixture: a synchronous sleep on the event loop."""
+
+import time
+
+
+class Poller:
+    async def poll(self):
+        time.sleep(0.5)                   # VIOLATION: blocks the loop
+        return 1

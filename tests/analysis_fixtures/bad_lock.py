@@ -1,0 +1,17 @@
+"""lock-discipline fixture: mutation of a guarded field outside the
+lock. The seeded violation is in ``drop`` (line noted in the test)."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}  # guarded-by: _lock
+
+    def record(self, key, value):
+        with self._lock:
+            self._entries[key] = value
+
+    def drop(self, key):
+        self._entries.pop(key, None)      # VIOLATION: no lock held

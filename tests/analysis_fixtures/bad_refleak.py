@@ -1,0 +1,8 @@
+"""ref-leak fixture: a dead-local ref and a discarded fire-and-forget
+ref."""
+
+
+def launch(task):
+    ref = task.remote(1)                 # VIOLATION: never read
+    task.remote(2)                       # VIOLATION: result discarded
+    return None

@@ -1,0 +1,9 @@
+"""rpc-surface fixture: a client call with no matching registration,
+and a registered handler no client calls."""
+
+
+def build(server, client):
+    server.register("do_work", lambda ctx: None)
+    server.register("orphaned_handler", lambda ctx: None)  # VIOLATION
+    client.call("do_work")
+    client.call("not_registered_anywhere")                 # VIOLATION

@@ -1,0 +1,226 @@
+"""graftcheck enforcement + self-tests.
+
+``test_repo_tree_is_clean`` is the tier-1 ratchet: the suite must exit
+0 over ``ray_tpu/`` (unsuppressed findings fail the build). The
+fixture tests pin each pass's detection on a seeded violation, and the
+clean fixture pins the false-positive floor.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from ray_tpu.devtools.analysis import run_analysis
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "analysis_fixtures")
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def _run(paths, **kw):
+    kw.setdefault("use_cache", False)
+    return run_analysis(paths, **kw)
+
+
+def test_repo_tree_is_clean():
+    """The enforcement gate: `python -m ray_tpu.devtools.analysis
+    ray_tpu/` exits 0 — zero unsuppressed findings on the tree."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.devtools.analysis",
+         os.path.join(ROOT, "ray_tpu"), "--no-cache"],
+        capture_output=True, text=True, env=env, cwd=ROOT, timeout=300)
+    assert proc.returncode == 0, (
+        f"graftcheck found unsuppressed issues:\n{proc.stdout}"
+        f"\n{proc.stderr}")
+
+
+def test_lock_discipline_flags_unlocked_mutation():
+    unsuppressed, _ = _run([_fixture("bad_lock.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "lock-discipline"]
+    assert len(hits) == 1
+    assert "_entries" in hits[0].message
+    assert hits[0].context == "Ledger.drop"
+
+
+def test_async_blocking_flags_sync_sleep():
+    unsuppressed, _ = _run([_fixture("bad_async.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "async-blocking"]
+    assert len(hits) == 1
+    assert "asyncio.sleep" in hits[0].message
+    assert hits[0].context == "Poller.poll"
+
+
+def test_rpc_surface_flags_drift_both_ways():
+    unsuppressed, _ = _run([_fixture("bad_rpc.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "rpc-surface"]
+    messages = " | ".join(f.message for f in hits)
+    assert "not_registered_anywhere" in messages   # orphaned caller
+    assert "orphaned_handler" in messages          # orphaned handler
+    assert len(hits) == 2
+
+
+def test_silent_exception_flags_undocumented_swallow():
+    unsuppressed, _ = _run([_fixture("bad_silent.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "silent-exception"]
+    assert len(hits) == 1
+    assert hits[0].context == "risky"
+
+
+def test_ref_leak_flags_dead_and_discarded_refs():
+    unsuppressed, _ = _run([_fixture("bad_refleak.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "ref-leak"]
+    assert len(hits) == 2
+    messages = " | ".join(f.message for f in hits)
+    assert "'ref'" in messages                     # dead local
+    assert "discarded" in messages                 # bare expression
+
+
+def test_clean_fixture_produces_zero_findings():
+    unsuppressed, all_findings = _run([_fixture("clean.py")])
+    assert all_findings == [], [f.render() for f in all_findings]
+
+
+def test_baseline_suppression_workflow(tmp_path):
+    """--update-baseline accepts current findings; a later run is
+    clean; a NEW finding still fails."""
+    baseline = str(tmp_path / "baseline.json")
+    unsuppressed, _ = _run([_fixture("bad_silent.py")],
+                           baseline_path=baseline,
+                           update_baseline=True)
+    assert unsuppressed == []
+    data = json.load(open(baseline))
+    assert len(data["findings"]) == 1
+    # suppressed on re-run
+    unsuppressed, all_findings = _run([_fixture("bad_silent.py")],
+                                      baseline_path=baseline)
+    assert unsuppressed == [] and len(all_findings) == 1
+    # a different file's findings are NOT suppressed
+    unsuppressed, _ = _run([_fixture("bad_refleak.py")],
+                           baseline_path=baseline)
+    assert len(unsuppressed) == 2
+
+
+def test_baseline_update_merges_unscanned_paths(tmp_path):
+    """Updating from a partial scan must not erase suppressions for
+    files the scan never looked at."""
+    baseline = str(tmp_path / "baseline.json")
+    _run([_fixture("bad_silent.py")], baseline_path=baseline,
+         update_baseline=True)
+    _run([_fixture("bad_refleak.py")], baseline_path=baseline,
+         update_baseline=True)
+    data = json.load(open(baseline))
+    paths = {e["path"] for e in data["findings"]}
+    assert any("bad_silent" in p for p in paths)       # preserved
+    assert any("bad_refleak" in p for p in paths)      # added
+    # both files now fully suppressed
+    for name in ("bad_silent.py", "bad_refleak.py"):
+        unsuppressed, _ = _run([_fixture(name)], baseline_path=baseline)
+        assert unsuppressed == []
+
+
+def test_baseline_does_not_suppress_new_identical_finding(tmp_path):
+    """One accepted swallow must not suppress a SECOND identical one
+    added later in the same scope (fingerprints carry an occurrence
+    ordinal)."""
+    mod = tmp_path / "mod.py"
+    baseline = str(tmp_path / "baseline.json")
+    one = ("def f(fn):\n"
+           "    try:\n"
+           "        return fn()\n"
+           "    except Exception:\n"
+           "        pass\n")
+    mod.write_text(one)
+    _run([str(mod)], root=str(tmp_path), baseline_path=baseline,
+         update_baseline=True)
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path),
+                           baseline_path=baseline)
+    assert unsuppressed == []
+    # a second identical violation appears in the same function
+    mod.write_text(one.replace("        pass\n",
+                               "        pass\n"
+                               "    try:\n"
+                               "        return fn()\n"
+                               "    except Exception:\n"
+                               "        pass\n"))
+    unsuppressed, all_findings = _run([str(mod)], root=str(tmp_path),
+                                      baseline_path=baseline)
+    assert len(all_findings) == 2
+    assert len(unsuppressed) == 1      # only the NEW one fails
+
+
+def test_update_baseline_refuses_pass_subset(tmp_path):
+    import pytest
+    with pytest.raises(ValueError):
+        _run([_fixture("bad_silent.py")],
+             baseline_path=str(tmp_path / "b.json"),
+             update_baseline=True, pass_ids=["silent-exception"])
+
+
+def test_lock_discipline_async_with(tmp_path):
+    """`async with self._lock:` counts as holding the lock."""
+    src = (
+        "import asyncio\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._lock = asyncio.Lock()\n"
+        "        self._items = {}  # guarded-by: _lock\n"
+        "    async def good(self, k):\n"
+        "        async with self._lock:\n"
+        "            self._items[k] = 1\n"
+        "    async def bad(self, k):\n"
+        "        self._items[k] = 1\n")
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    unsuppressed, _ = _run([str(p)], root=str(tmp_path))
+    hits = [f for f in unsuppressed if f.pass_id == "lock-discipline"]
+    assert [h.context for h in hits] == ["A.bad"]
+
+
+def test_per_file_cache_reused(tmp_path):
+    """Second run with the cache enabled reproduces identical findings
+    (the cache stores per-file results keyed on mtime/size)."""
+    import shutil
+    root = tmp_path / "proj"
+    root.mkdir()
+    shutil.copy(_fixture("bad_silent.py"), root / "bad_silent.py")
+    first, _ = _run([str(root)], root=str(root), use_cache=True)
+    assert (root / ".rtpu_analysis_cache.json").exists()
+    second, _ = _run([str(root)], root=str(root), use_cache=True)
+    assert [f.to_json() for f in first] == [f.to_json() for f in second]
+
+
+def test_rpc_introspection_matches_static_scan():
+    """The runtime half of the rpc-surface check: every registration
+    the static pass sees in gcs_server.py exists in a live GcsServer's
+    handler table."""
+    from ray_tpu._private.gcs_server import GcsServer
+    from ray_tpu.devtools.analysis.core import parse_file
+    from ray_tpu.devtools.analysis.passes.rpc_surface import _scan_file
+
+    src = os.path.join(ROOT, "ray_tpu", "_private", "gcs_server.py")
+    ctx = parse_file(src, ROOT)
+    static_regs, _calls = _scan_file(ctx)
+    gs = GcsServer()
+    try:
+        live = set(gs.rpc_methods())
+    finally:
+        gs.shutdown()
+    missing = set(static_regs) - live
+    assert not missing, f"statically registered but not live: {missing}"
+
+
+def test_registered_methods_hook():
+    from ray_tpu._private.rpc import RpcServer
+    server = RpcServer()
+    try:
+        server.register("beta", lambda ctx: None)
+        server.register("alpha", lambda ctx: None)
+        assert server.registered_methods() == ("alpha", "beta")
+    finally:
+        server.shutdown()
