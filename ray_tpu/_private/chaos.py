@@ -1,0 +1,278 @@
+"""Chaos plane: deterministic, seedable fault injection for the wire
+and process layers.
+
+Reference analog: the reference's failure tests reach into raylets
+with ``kill -9`` and OS-level network partitions; this runtime instead
+carries its own injection points so every failure path is exercisable
+deterministically in-process and cross-process (the raylet/GCS/worker
+children inherit the rules through the environment).
+
+A **rule** names an event and an action::
+
+    component.point.method:action[=arg][@after][xCount]
+
+- ``component``: who fires the event — ``gcs_client``, ``gcs``,
+  ``gcs_health``, ``raylet``, ``raylet_channel``, ``worker``,
+  ``worker_pool``, ... (fnmatch patterns, ``*`` matches any).
+- ``point``: where in the stack — ``send`` / ``recv`` (frame I/O),
+  ``dispatch`` (server handler entry), ``spawn`` / ``teardown``
+  (worker-pool process lifecycle), ``boot`` / ``exec`` (inside a
+  worker process).
+- ``method``: the RPC method / push topic / task name at the event
+  (``reply`` for reply frames; empty for lifecycle points).
+- ``action``: ``drop`` (frame vanishes), ``delay=SECONDS`` (stall),
+  ``dup`` (frame or dispatch happens twice), ``sever`` (the
+  connection dies mid-flight), ``kill`` (the process exits
+  ``KILL_EXIT_CODE`` at the event — the chaos analog of kill -9).
+- ``@after``: fire on the Nth *matching* event (1-based, default 1);
+  earlier matches count but pass through.
+- ``xCount``: keep firing for this many consecutive matches
+  (default 1; ``x*`` = every match from ``@after`` on).
+
+Rules are matched first-hit-wins in install order. Matching and
+trigger counting are fully deterministic; an optional ``%prob``
+suffix makes a rule probabilistic, evaluated against the plane's
+seeded RNG so a fixed seed reproduces the exact firing sequence.
+
+Rules arrive three ways:
+
+- programmatic: ``chaos.install("gcs_client.send.kv_put:sever")``
+  (tests in the same process);
+- environment: ``RTPU_CHAOS`` (child processes inherit it — raylet,
+  GCS, and worker processes arm themselves at entry);
+- config: the ``chaos_rules`` system-config knob, which travels to
+  spawned raylet/GCS processes with the serialized config.
+
+Hook sites call ``chaos.fire(component, point, method)``; with no
+rules installed that is one predicate check, so the production hot
+path stays effectively free.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import logging
+import os
+import random
+import re
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple, Union
+
+logger = logging.getLogger(__name__)
+
+ENV_VAR = "RTPU_CHAOS"
+ENV_SEED_VAR = "RTPU_CHAOS_SEED"
+
+# Exit status of a chaos 'kill' — distinctive, so tests (and humans
+# reading a raylet log) can tell an injected death from a real crash.
+KILL_EXIT_CODE = 42
+
+ACTIONS = ("drop", "delay", "dup", "sever", "kill")
+POINTS = ("send", "recv", "dispatch", "spawn", "teardown", "boot",
+          "exec", "*")
+
+_RULE_RE = re.compile(
+    r"^(?P<component>[^.:\s]+)\.(?P<point>[^.:\s]+)\.(?P<method>[^:\s]*)"
+    r":(?P<action>[a-z]+)"
+    r"(?:=(?P<arg>[0-9.]+))?"
+    r"(?:@(?P<after>[0-9]+))?"
+    r"(?:x(?P<count>[0-9]+|\*))?"
+    r"(?:%(?P<prob>[0-9.]+))?$")
+
+
+class ChaosRuleError(ValueError):
+    """A rule string does not parse / names an unknown action."""
+
+
+class ChaosRule:
+    """One parsed injection rule plus its live trigger counters."""
+
+    __slots__ = ("component", "point", "method", "action", "arg",
+                 "after", "count", "prob", "matched", "fired")
+
+    def __init__(self, component: str, point: str, method: str,
+                 action: str, arg: float = 0.0, after: int = 1,
+                 count: int = 1, prob: Optional[float] = None):
+        if action not in ACTIONS:
+            raise ChaosRuleError(
+                f"unknown chaos action {action!r} (one of {ACTIONS})")
+        if after < 1:
+            raise ChaosRuleError("@after is 1-based; got "
+                                 f"{after}")
+        self.component = component
+        self.point = point
+        self.method = method
+        self.action = action
+        self.arg = arg
+        self.after = after
+        self.count = count          # -1 = unlimited
+        self.prob = prob
+        self.matched = 0            # events this rule pattern-matched
+        self.fired = 0              # events it actually acted on
+
+    @classmethod
+    def parse(cls, text: str) -> "ChaosRule":
+        m = _RULE_RE.match(text.strip())
+        if m is None:
+            raise ChaosRuleError(
+                f"bad chaos rule {text!r}: expected "
+                "component.point.method:action[=arg][@after][xN][%p]")
+        count_s = m.group("count")
+        return cls(
+            component=m.group("component"),
+            point=m.group("point"),
+            method=m.group("method") or "*",
+            action=m.group("action"),
+            arg=float(m.group("arg") or 0.0),
+            after=int(m.group("after") or 1),
+            count=(-1 if count_s == "*" else int(count_s or 1)),
+            prob=(float(m.group("prob"))
+                  if m.group("prob") is not None else None))
+
+    def matches(self, component: str, point: str, method: str) -> bool:
+        return (fnmatch.fnmatchcase(component, self.component)
+                and fnmatch.fnmatchcase(point, self.point)
+                and fnmatch.fnmatchcase(method or "", self.method))
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"ChaosRule({self.component}.{self.point}.{self.method}"
+                f":{self.action}@{self.after}x{self.count} "
+                f"matched={self.matched} fired={self.fired})")
+
+
+class ChaosPlane:
+    """Rule store + event evaluator. One per process (module global);
+    tests may build private planes for unit-testing the matcher."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self._rules: List[ChaosRule] = []  # guarded-by: _lock
+        self._rng = random.Random(seed)
+        # fired events, for assertions: (component, point, method, action)
+        self.events: List[Tuple[str, str, str, str]] = []  # guarded-by: _lock
+        self.armed = False
+
+    def install(self, rules: Union[str, Sequence],
+                seed: Optional[int] = None) -> None:
+        """Add rules (a spec string with ``;``-separated rules, or a
+        sequence of strings / ChaosRule objects). Arms the plane."""
+        parsed: List[ChaosRule] = []
+        if isinstance(rules, str):
+            rules = [r for r in rules.split(";") if r.strip()]
+        for r in rules:
+            parsed.append(r if isinstance(r, ChaosRule)
+                          else ChaosRule.parse(r))
+        with self._lock:
+            if seed is not None:
+                self._rng = random.Random(seed)
+            self._rules.extend(parsed)
+            self.armed = bool(self._rules)
+        if parsed:
+            logger.warning("chaos plane armed: %d rule(s) active",
+                           len(parsed))
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules.clear()
+            self.events.clear()
+            self.armed = False
+
+    def rules(self) -> List[ChaosRule]:
+        with self._lock:
+            return list(self._rules)
+
+    def fire(self, component: str, point: str, method: str = ""
+             ) -> Optional[str]:
+        """Evaluate one event. Returns the action the HOOK SITE must
+        apply (``drop`` / ``dup`` / ``sever``) or None to proceed
+        normally; ``delay`` sleeps here and ``kill`` exits here."""
+        if not self.armed:
+            return None
+        action = None
+        arg = 0.0
+        with self._lock:
+            for rule in self._rules:
+                if not rule.matches(component, point, method):
+                    continue
+                rule.matched += 1
+                if rule.matched < rule.after:
+                    continue
+                if (rule.count >= 0
+                        and rule.matched >= rule.after + rule.count):
+                    continue
+                if (rule.prob is not None
+                        and self._rng.random() >= rule.prob):
+                    continue
+                rule.fired += 1
+                action, arg = rule.action, rule.arg
+                self.events.append((component, point, method, action))
+                break
+        if action is None:
+            return None
+        if action == "delay":
+            time.sleep(arg)
+            return None
+        if action == "kill":
+            logger.warning("chaos: kill at %s.%s.%s (pid %d)",
+                           component, point, method, os.getpid())
+            # os._exit, not sys.exit: the point is an abrupt death with
+            # no cleanup, finally-blocks, or atexit — the kill -9 analog.
+            os._exit(KILL_EXIT_CODE)
+        logger.warning("chaos: %s at %s.%s.%s", action, component,
+                       point, method)
+        return action
+
+
+_plane = ChaosPlane()
+
+
+def get_plane() -> ChaosPlane:
+    return _plane
+
+
+def active() -> bool:
+    return _plane.armed
+
+
+def fire(component: str, point: str, method: str = "") -> Optional[str]:
+    """Module-level hook entry: cheap no-op while unarmed."""
+    if not _plane.armed:
+        return None
+    return _plane.fire(component, point, method)
+
+
+def install(rules: Union[str, Sequence], seed: Optional[int] = None
+            ) -> None:
+    _plane.install(rules, seed=seed)
+
+
+def clear() -> None:
+    _plane.clear()
+
+
+def events() -> List[Tuple[str, str, str, str]]:
+    with _plane._lock:
+        return list(_plane.events)
+
+
+def maybe_arm() -> None:
+    """Arm from the environment (RTPU_CHAOS) or the ``chaos_rules``
+    config knob. Called at every process entrypoint (driver init,
+    raylet/GCS main, worker_main); idempotent when nothing is set.
+    The env var wins — it is how tests scope rules to one child."""
+    if _plane.armed:
+        return
+    spec = os.environ.get(ENV_VAR, "")
+    seed_s = os.environ.get(ENV_SEED_VAR, "")
+    if not spec:
+        try:
+            from ray_tpu._private.config import get_config
+            spec = get_config().chaos_rules
+            if not seed_s:
+                seed_s = str(get_config().chaos_seed)
+        except Exception:
+            logger.debug("chaos config unavailable", exc_info=True)
+            spec = ""
+    if spec:
+        _plane.install(spec, seed=int(seed_s) if seed_s else 0)

@@ -151,6 +151,33 @@ _D("worker_pool_prestart", int, 0, "Workers to pre-fork at init.")
 _D("worker_pool_max_idle_s", float, 60.0, "Idle worker reap time.")
 _D("worker_start_timeout_s", float, 60.0, "Worker process start timeout.")
 
+# --- rpc transport hardening (reference: grpc client retry knobs) ---
+_D("rpc_reconnect_backoff_base_ms", int, 50,
+   "Initial delay between reconnect attempts of a retrying RPC "
+   "client; doubles per attempt (with jitter).")
+_D("rpc_reconnect_backoff_max_ms", int, 2000,
+   "Reconnect backoff ceiling.")
+_D("rpc_call_deadline_ms", int, 30000,
+   "Default overall deadline of one logical call on a retrying RPC "
+   "client, spanning reconnects and idempotent re-sends.")
+_D("rpc_dedupe_cache_size", int, 4096,
+   "Server-side idempotency-token dedupe cache entries (LRU): a "
+   "retried call whose token is cached replays the recorded reply "
+   "instead of re-executing the handler.")
+_D("raylet_channel_reconnect_ms", int, 3000,
+   "How long the owner's channel to a raylet keeps trying to "
+   "reconnect after a connection loss before the node is declared "
+   "lost (its tasks then retry on survivors).")
+
+# --- chaos / fault injection (tests only; see _private/chaos.py) ---
+_D("chaos_rules", str, "",
+   "Fault-injection rules (component.point.method:action[...]; "
+   "';'-separated). Empty = chaos plane disarmed. The RTPU_CHAOS "
+   "env var overrides per-process.")
+_D("chaos_seed", int, 0,
+   "Seed for probabilistic chaos rules; fixed seed = reproducible "
+   "firing sequence.")
+
 # --- gcs / health ---
 _D("gcs_mode", str, "inproc",
    "'inproc' hosts the GCS tables in the driver; 'process' spawns a "

@@ -20,48 +20,57 @@ from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private.gcs import ActorInfo, NodeInfo, Publisher
 from ray_tpu._private.ids import ActorID, NodeID
-from ray_tpu._private.rpc import RpcClient
+from ray_tpu._private.rpc import RetryingRpcClient
 
 logger = logging.getLogger(__name__)
 
 
 class GcsClient:
-    """Survives a GCS restart: a call hitting a dead connection
-    reconnects (the restarted server reloads its persisted tables) and
-    re-subscribes before retrying once — the reference's
-    gcs-fault-tolerance client behavior."""
+    """Survives GCS restarts and severed connections: the channel is a
+    ``RetryingRpcClient`` — connection loss reconnects with exponential
+    backoff (in the background too, so push subscriptions resume even
+    on a call-idle client), re-subscribes the push channels, and
+    re-sends the in-flight call under its idempotency token. Against a
+    LIVE server (severed/dropped connection) that makes mutations
+    exactly-once; across a GCS process crash+restart the dedupe cache
+    is gone with the process, so a call executed right before the
+    crash may re-execute (at-least-once, like the reference).
+    ``on_reconnect`` (when set) runs after every restored connection —
+    the raylet re-registers its node there."""
 
     def __init__(self, address: Tuple[str, int]):
         self.address = tuple(address)
         self.publisher = Publisher()
         self._actor_cache: Dict[ActorID, ActorInfo] = {}
         self._cache_lock = threading.Lock()
-        self._reconnect_lock = threading.Lock()
-        self._connect()
+        # external re-register hook, fired after a restored connection
+        self.on_reconnect: Optional[callable] = None
+        self._client = RetryingRpcClient(
+            self.address, on_push=self._on_push, component="gcs_client",
+            on_reconnect=self._resync, on_restored=self._restored,
+            auto_reconnect=True, reconnect_window=None,
+            attempt_timeout=5.0)
 
-    def _connect(self) -> None:
-        self._client = RpcClient(self.address, on_push=self._on_push)
+    def _resync(self, raw) -> None:
+        """Connection-scoped state, rebuilt on every (re)connect: the
+        push subscriptions live server-side per connection, and any
+        cached actor info may be stale across the gap."""
         for channel in ("NODE", "ACTOR", "RESOURCES"):
-            self._client.call("subscribe", channel)
+            raw.call("subscribe", channel, timeout=10.0)
+        with self._cache_lock:
+            self._actor_cache.clear()
+
+    def _restored(self) -> None:
+        cb = self.on_reconnect
+        if cb is not None:
+            cb()
+
+    @property
+    def num_reconnects(self) -> int:
+        return self._client.num_reconnects
 
     def _call(self, method: str, *args, timeout: float = 30.0):
-        try:
-            return self._client.call(method, *args, timeout=timeout)
-        except (ConnectionError, OSError, TimeoutError) as e:
-            # Retry only on connection loss. A timeout with the connection
-            # still alive means a slow server may yet execute the request;
-            # re-sending a non-idempotent mutation (next_job_id,
-            # register_actor) would apply it twice.
-            if isinstance(e, TimeoutError) and self._client.alive:
-                raise
-            with self._reconnect_lock:
-                if not self._client.alive:
-                    from ray_tpu._private.rpc import wait_for_server
-                    wait_for_server(self.address, timeout=30.0)
-                    self._connect()
-            with self._cache_lock:
-                self._actor_cache.clear()
-            return self._client.call(method, *args, timeout=timeout)
+        return self._client.call(method, *args, timeout=timeout)
 
     def _on_push(self, topic: str, message) -> None:
         if topic == "ACTOR":

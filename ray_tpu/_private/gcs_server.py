@@ -42,6 +42,8 @@ class GcsServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  persist_path: Optional[str] = None):
+        from ray_tpu._private import chaos
+        chaos.maybe_arm()
         self.state = GcsLite()
         self._persist_path = persist_path
         if persist_path and os.path.exists(persist_path):
@@ -62,7 +64,7 @@ class GcsServer:
         self._health_fails: Dict[NodeID, int] = {}
         self._shutdown = threading.Event()
 
-        self.server = RpcServer(host, port)
+        self.server = RpcServer(host, port, component="gcs")
         self.address = self.server.address
         s = self.server
         s.register("ping", lambda ctx: "pong")
@@ -208,7 +210,10 @@ class GcsServer:
                 try:
                     client = clients.get(node_id)
                     if client is None or not client.alive:
-                        client = RpcClient(addr, connect_timeout=period)
+                        # plain client on purpose: health probes must
+                        # FAIL on a dead node, not mask it with retries
+                        client = RpcClient(addr, connect_timeout=period,
+                                           component="gcs_health")
                         clients[node_id] = client
                     client.call("ping", timeout=period * 2)
                     ok = True

@@ -34,6 +34,14 @@ from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.task_spec import FunctionDescriptor, TaskOptions
 from ray_tpu.exceptions import GetTimeoutError, TaskError
 
+# Deadlines on the nested control protocol (retry-discipline): these
+# are owner round trips that answer promptly on a live driver — only
+# nested_get/nested_wait block on object readiness, and they compute
+# their own user-timeout-derived deadlines. _SHIP covers calls that
+# carry function/object blobs (serialization + transfer time).
+_CONTROL_TIMEOUT = 60.0
+_SHIP_TIMEOUT = 300.0
+
 _SHIPPED_OPTION_FIELDS = (
     "num_cpus", "num_tpus", "num_gpus", "memory", "resources",
     "num_returns", "max_retries", "name", "scheduling_strategy",
@@ -112,7 +120,8 @@ class NestedClient:
         fid = fn_descriptor.function_id
         refs_b = self._client.call(
             "nested_submit", fid, self._fn_shipment(fid),
-            fn_descriptor.name, arg_descs, kwargs_keys, options_dict)
+            fn_descriptor.name, arg_descs, kwargs_keys, options_dict,
+            timeout=_SHIP_TIMEOUT)
         return [ObjectRef(ObjectID(b)) for b in refs_b]
 
     # -- object plane ----------------------------------------------------
@@ -231,7 +240,8 @@ class NestedClient:
         actor_id_b = self._client.call(
             "nested_create_actor", fid, self._fn_shipment(fid),
             class_name, arg_descs, kwargs_keys, options_dict,
-            tuple(method_names), bool(is_async))
+            tuple(method_names), bool(is_async),
+            timeout=_SHIP_TIMEOUT)
         return ActorID(actor_id_b)
 
     def submit_actor_task(self, actor_id, method_name: str, args: tuple,
@@ -241,17 +251,19 @@ class NestedClient:
         options_dict = {"num_returns": options.num_returns}
         refs_b = self._client.call(
             "nested_actor_task", actor_id.binary(), method_name,
-            arg_descs, kwargs_keys, options_dict)
+            arg_descs, kwargs_keys, options_dict,
+            timeout=_SHIP_TIMEOUT)
         return [ObjectRef(ObjectID(b)) for b in refs_b]
 
     def kill_actor(self, actor_id) -> None:
-        self._client.call("nested_kill_actor", actor_id.binary())
+        self._client.call("nested_kill_actor", actor_id.binary(),
+                          timeout=_CONTROL_TIMEOUT)
 
     def cancel_task(self, ref, force: bool = False) -> None:
         """Proxy ray_tpu.cancel() to the owner (the driver runs the
         actual queue removal / worker interruption)."""
         self._client.call("nested_cancel", ref.id().binary(),
-                          bool(force))
+                          bool(force), timeout=_CONTROL_TIMEOUT)
 
     @property
     def gcs(self):
@@ -260,7 +272,8 @@ class NestedClient:
         class _NestedGcs:
             def get_named_actor(self, name: str, namespace: str):
                 return client._client.call("nested_named_actor", name,
-                                           namespace)
+                                           namespace,
+                                           timeout=_CONTROL_TIMEOUT)
 
         return _NestedGcs()
 
@@ -268,14 +281,17 @@ class NestedClient:
 
     def create_placement_group(self, pg_id, bundles, strategy, name):
         self._client.call("nested_create_pg", pg_id.binary(),
-                          [dict(b) for b in bundles], strategy, name)
+                          [dict(b) for b in bundles], strategy, name,
+                          timeout=_CONTROL_TIMEOUT)
 
     def remove_placement_group(self, pg_id) -> None:
-        self._client.call("nested_remove_pg", pg_id.binary())
+        self._client.call("nested_remove_pg", pg_id.binary(),
+                          timeout=_CONTROL_TIMEOUT)
 
     def pg_ready_ref(self, pg_id) -> ObjectRef:
         return ObjectRef(ObjectID(
-            self._client.call("nested_pg_ready", pg_id.binary())))
+            self._client.call("nested_pg_ready", pg_id.binary(),
+                              timeout=_CONTROL_TIMEOUT)))
 
     @property
     def pg_manager(self):
@@ -289,19 +305,23 @@ class NestedClient:
         class _Shim:
             def get(self, pg_id):
                 out = client._client.call("nested_pg_info",
-                                          pg_id.binary())
+                                          pg_id.binary(),
+                                          timeout=_CONTROL_TIMEOUT)
                 return None if out is None else _Info(*out)
 
             def table(self):
-                return client._client.call("nested_pg_table")
+                return client._client.call("nested_pg_table",
+                                            timeout=_CONTROL_TIMEOUT)
 
         return _Shim()
 
     def cluster_resources(self) -> dict:
-        return self._client.call("nested_cluster_resources")
+        return self._client.call("nested_cluster_resources",
+                                 timeout=_CONTROL_TIMEOUT)
 
     def available_resources(self) -> dict:
-        return self._client.call("nested_available_resources")
+        return self._client.call("nested_available_resources",
+                                 timeout=_CONTROL_TIMEOUT)
 
     def close(self) -> None:
         self._client.close()
@@ -350,11 +370,13 @@ class ClientWorker(NestedClient):
 
     def put(self, value):
         blob = self.serde.serialize(value).to_bytes()
-        oid_b = self._client.call("nested_put", blob)
+        oid_b = self._client.call("nested_put", blob,
+                                  timeout=_SHIP_TIMEOUT)
         return ObjectRef(ObjectID(oid_b))
 
     def _get_function_blob(self, fid: bytes) -> bytes:
-        return self._client.call("nested_function_blob", fid)
+        return self._client.call("nested_function_blob", fid,
+                                 timeout=_SHIP_TIMEOUT)
 
     def shutdown(self) -> None:
         self.close()

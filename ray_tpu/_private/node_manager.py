@@ -153,11 +153,21 @@ class RemoteActorWorker:
 
 class RemoteNodeHandle:
     """Driver-side proxy of a raylet process (lease channel + object
-    manager address + liveness)."""
+    manager address + liveness).
+
+    The channel is a ``RetryingRpcClient``: a dropped or severed
+    connection reconnects in the background (re-running
+    ``register_owner`` so completion pushes resume on the new
+    connection) and in-flight lease calls re-send under their
+    idempotency tokens — a transient network fault no longer costs the
+    whole node. Only when reconnection keeps failing for
+    ``raylet_channel_reconnect_ms`` is the node declared lost (its
+    tasks then retry on survivors)."""
 
     def __init__(self, group: "NodeManagerGroup", node_id: NodeID,
                  addr, resources: NodeResources, proc=None):
-        from ray_tpu._private.rpc import RpcClient
+        from ray_tpu._private.rpc import RetryingRpcClient
+        cfg = get_config()
         self.node_id = node_id
         self.addr = tuple(addr)
         self.resources = resources
@@ -165,19 +175,45 @@ class RemoteNodeHandle:
         self.alive = True
         self.known_functions: set = set()
         self._group = group
-        self.client = RpcClient(self.addr, on_push=self._on_push,
-                                on_close=self._on_close)
-        self.client.call("register_owner")
+        self.client = RetryingRpcClient(
+            self.addr, on_push=self._on_push,
+            component="raylet_channel",
+            on_reconnect=self._register_owner,
+            on_give_up=self._on_give_up,
+            should_reconnect=self._peer_may_return,
+            auto_reconnect=True,
+            reconnect_window=cfg.raylet_channel_reconnect_ms / 1000.0,
+            call_deadline=cfg.worker_lease_timeout_ms / 1000.0)
+
+    def _peer_may_return(self) -> bool:
+        """A raylet process WE spawned that has exited can never answer
+        a reconnect — skip the backoff window and let node-lost fire
+        now (elastic shrink must not lag a known-dead child). Attached
+        peers (proc None) keep the full window: their death is only
+        visible through the network."""
+        return self.proc is None or self.proc.poll() is None
+
+    def _register_owner(self, raw) -> None:
+        """Per-connection server state: the raylet routes completion
+        pushes to the registered owner channel; every (re)connect must
+        re-establish it before anything else. The session string is
+        this driver's stable identity across reconnects — the raylet
+        scopes dead-connection adoption to it, so one driver's
+        reconnect never cancels another driver's teardown."""
+        raw.call("register_owner", self._group._session, timeout=10.0)
+
+    def _on_give_up(self, exc: BaseException) -> None:
+        if self.alive:
+            logger.warning("raylet channel to %s not restored (%s); "
+                           "declaring node lost",
+                           self.node_id.hex()[:8], exc)
+            self._group._on_remote_node_lost(self.node_id)
 
     def _on_push(self, topic: str, payload) -> None:
         try:
             self._group._on_remote_push(self, topic, payload)
         except Exception:
             logger.exception("error handling push from %s", self.node_id)
-
-    def _on_close(self) -> None:
-        if self.alive:
-            self._group._on_remote_node_lost(self.node_id)
 
 
 class NodeManagerGroup:

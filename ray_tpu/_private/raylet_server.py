@@ -61,6 +61,8 @@ class RayletServer:
                  max_process_workers: int = 2,
                  object_store_memory: Optional[int] = None,
                  labels: Optional[Dict[str, str]] = None):
+        from ray_tpu._private import chaos
+        chaos.maybe_arm()
         cfg = get_config()
         self.node_id = node_id
         self.session = session          # node-scoped namespace
@@ -98,6 +100,21 @@ class RayletServer:
         # (the detached-actor case) without stealing each other's
         # completions; _owner_ctx stays as the fallback.
         self._task_ctx: Dict[bytes, ConnectionContext] = {}
+        # Owner-reconnect tolerance: a disconnected channel is NOT
+        # torn down immediately — the owner's retrying client may be
+        # mid-reconnect. Dead ctxs wait out a grace period here
+        # (ctx -> purge deadline); a returning register_owner adopts
+        # their routing state, and pushes that found no live channel
+        # buffer in _undelivered for replay on that re-register.
+        self._dead_ctxs: Dict[ConnectionContext, float] = {}  # guarded-by: _lock
+        self._undelivered: List[Tuple[str, dict]] = []  # guarded-by: _lock
+        # True while a registration replay is draining _undelivered:
+        # new pushes are routed INTO the buffer so they queue behind
+        # the backlog — a direct push overtaking buffered stream items
+        # would be dropped owner-side as a stale duplicate (the item
+        # index only moves forward). Cleared atomically with the
+        # drain's emptiness check.
+        self._replaying = False  # guarded-by: _lock
         # Authoritative local usage: what running tasks and resident
         # actors nominally demand — the heartbeat reports total minus
         # this (reference: LocalResourceManager's available view).
@@ -109,7 +126,7 @@ class RayletServer:
         from ray_tpu._private.pip_env import PipEnvManager
         self._pip_envs = PipEnvManager(self._on_pip_env_requeue)
 
-        self.server = RpcServer()
+        self.server = RpcServer(component="raylet")
         self.address = self.server.address
         serve_store(self.server, self._object_view, self._free_object)
         self.server.register("ping", lambda ctx: "pong")
@@ -139,11 +156,14 @@ class RayletServer:
         self.gcs: Optional[GcsClient] = None
         if gcs_addr is not None:
             self.gcs = GcsClient(gcs_addr)
-            self.gcs.register_node(
-                NodeInfo(node_id=node_id,
-                         resources_total=dict(self.resources_total),
-                         labels=self.labels),
-                rpc_addr=self.address)
+            # A severed/restarted GCS connection re-registers this node
+            # the moment the channel is restored: a restarted GCS (or
+            # one that declared us dead during the gap) relearns the
+            # node and its health-check address without waiting for an
+            # operator (reference: raylet re-registration on GCS
+            # restart).
+            self.gcs.on_reconnect = self._re_register_with_gcs
+            self._re_register_with_gcs()
             self._hb_thread = threading.Thread(
                 target=self._heartbeat_loop, daemon=True, name="rtpu-raylet-hb")
             self._hb_thread.start()
@@ -158,21 +178,101 @@ class RayletServer:
 
     # -- owner channel -------------------------------------------------
 
-    def _register_owner(self, ctx: ConnectionContext) -> str:
+    _UNDELIVERED_CAP = 10_000
+
+    def _register_owner(self, ctx: ConnectionContext,
+                        owner_id: Optional[str] = None) -> str:
+        """Bind the owner channel. A RE-registration (the same owner's
+        retrying client reconnected — ``owner_id`` is the driver's
+        stable identity) adopts the routing state stranded on its OWN
+        dead predecessor connections and replays pushes that found no
+        live channel during the gap — a survived sever costs nothing
+        but latency. Other drivers' dead connections keep their purge
+        schedule: one owner's reconnect must not cancel another's
+        teardown or steal its completions."""
+        ctx.meta["owner_id"] = owner_id
+        with self._lock:
+            # Gate BEFORE the ctx becomes reachable: pushes racing the
+            # replay must queue behind the backlog, not overtake it.
+            if self._undelivered:
+                self._replaying = True
         with self._owner_lock:
             self._owner_ctx = ctx
+        with self._lock:
+            for tid, c in list(self._task_ctx.items()):
+                if (c is not ctx and not c.alive
+                        and c.meta.get("owner_id") == owner_id):
+                    self._task_ctx[tid] = ctx
+            for aid, c in list(self._actor_ctx.items()):
+                if (c is not ctx and not c.alive
+                        and c.meta.get("owner_id") == owner_id):
+                    self._actor_ctx[aid] = ctx
+            for c in [c for c in self._dead_ctxs
+                      if c.meta.get("owner_id") == owner_id]:
+                self._dead_ctxs.pop(c, None)
+        self._drain_undelivered(ctx)
         return "ok"
+
+    def _drain_undelivered(self, target: ConnectionContext) -> None:
+        """Replay buffered pushes to ``target``, re-buffering the
+        remainder if it dies mid-drain. Loops until the buffer is
+        empty so an append racing a concurrent drain is picked up
+        (the _replaying gate routes concurrent pushes into the buffer,
+        keeping per-task delivery order). A stale completion reaching
+        the wrong driver is a no-op there (unknown task ids are
+        discarded on the owner side)."""
+        while True:
+            with self._lock:
+                if not self._undelivered:
+                    self._replaying = False
+                    return
+                batch, self._undelivered = self._undelivered, []
+            for i, (topic, payload) in enumerate(batch):
+                if not target.push(topic, payload):
+                    with self._lock:
+                        self._undelivered = (batch[i:]
+                                             + self._undelivered)
+                        # target died: direct pushes will fail too, so
+                        # buffering order is preserved without the gate
+                        self._replaying = False
+                    return
 
     def _push_owner(self, topic: str, payload,
                     ctx: Optional[ConnectionContext] = None) -> None:
         """Push to the submitting connection when known (``ctx``),
-        falling back to the registered owner channel."""
+        falling back to the registered owner channel; with neither
+        live, buffer for replay at the owner's re-registration (its
+        retrying channel may be mid-reconnect)."""
+        with self._lock:
+            if self._replaying \
+                    and len(self._undelivered) < self._UNDELIVERED_CAP:
+                # registration replay in flight: queue behind the
+                # backlog so stream items keep their delivery order
+                self._undelivered.append((topic, payload))
+                return
         if ctx is not None and ctx.push(topic, payload):
             return
         with self._owner_lock:
             owner = self._owner_ctx
-        if owner is None or owner is ctx or not owner.push(topic, payload):
-            logger.warning("owner channel gone; dropping %s", topic)
+        if owner is not None and owner is not ctx \
+                and owner.push(topic, payload):
+            return
+        with self._lock:
+            buffered = len(self._undelivered) < self._UNDELIVERED_CAP
+            if buffered:
+                self._undelivered.append((topic, payload))
+        if not buffered:
+            logger.warning("owner channel gone and replay buffer "
+                           "full; dropping %s", topic)
+            return
+        # Close the race with a concurrent register_owner: if a live
+        # owner appeared between our check and the append, its drain
+        # may have missed the entry — drain to it now. Otherwise the
+        # entry waits for the next registration.
+        with self._owner_lock:
+            now_owner = self._owner_ctx
+        if now_owner is not None and now_owner.alive:
+            self._drain_undelivered(now_owner)
 
     def _ctx_for_task(self, task_id: bytes, pop: bool = False
                       ) -> Optional[ConnectionContext]:
@@ -182,11 +282,36 @@ class RayletServer:
             return self._task_ctx.get(task_id)
 
     def _on_conn_disconnect(self, ctx: ConnectionContext) -> None:
-        """A driver's channel closed. Reap its non-detached actors
-        (nothing will ever call them again); keep detached ones."""
+        """A driver's channel closed — but its retrying client may be
+        mid-reconnect, so teardown is DEFERRED by a grace period (the
+        owner's reconnect window plus slack). If register_owner
+        arrives first, the new connection adopts this one's routing
+        state and nothing is lost; only an expired grace purges."""
         with self._owner_lock:
             if self._owner_ctx is ctx:
                 self._owner_ctx = None
+        grace = get_config().raylet_channel_reconnect_ms / 1000.0 + 2.0
+        with self._lock:
+            self._dead_ctxs[ctx] = time.monotonic() + grace
+        self._wake.set()
+
+    def _sweep_dead_ctxs(self) -> None:
+        """Purge disconnected channels whose reconnect grace expired
+        (runs on the dispatch loop's tick)."""
+        now = time.monotonic()
+        with self._lock:
+            expired = [c for c, deadline in self._dead_ctxs.items()
+                       if deadline <= now]
+            for c in expired:
+                self._dead_ctxs.pop(c, None)
+        for ctx in expired:
+            self._purge_disconnected(ctx)
+
+    def _purge_disconnected(self, ctx: ConnectionContext) -> None:
+        """The owner really is gone: reap its non-detached actors
+        (nothing will ever call them again); keep detached ones.
+        Routing state a re-registered owner already adopted no longer
+        points at ``ctx`` and is naturally spared."""
         doomed: List[bytes] = []
         with self._lock:
             for tid in [t for t, c in self._task_ctx.items() if c is ctx]:
@@ -399,6 +524,7 @@ class RayletServer:
             self._wake.wait(timeout=0.1)
             self._wake.clear()
             try:
+                self._sweep_dead_ctxs()
                 self._dispatch_all()
             except Exception:
                 logger.exception("raylet dispatch error")
@@ -681,6 +807,15 @@ class RayletServer:
         self._wake.set()
 
     # -- gcs heartbeat -------------------------------------------------
+
+    def _re_register_with_gcs(self) -> None:
+        """(Re-)announce this node to the GCS; runs at startup and
+        after every restored GCS connection."""
+        self.gcs.register_node(
+            NodeInfo(node_id=self.node_id,
+                     resources_total=dict(self.resources_total),
+                     labels=self.labels),
+            rpc_addr=self.address)
 
     def available_resources(self) -> Dict[str, float]:
         """Actual free capacity: total minus what running tasks and

@@ -15,10 +15,24 @@ stale-version peer: the receiver answers with a ("hello_err", reason)
 frame and closes. Messages:
   ("hello", version, token)         client -> server, FIRST frame
   ("hello_ok",) / ("hello_err", r)  server -> client, handshake reply
-  ("call",  req_id, method, args)   client -> server
+  ("call",  req_id, method, args[, idem])   client -> server
   ("reply", req_id, ok, payload)    server -> client
   ("oneway", method, args)          client -> server, no reply
   ("push",  topic, payload)         server -> client, no reply
+
+The optional 5th "call" element is an idempotency token: the server
+keeps an LRU dedupe cache of token -> recorded reply, so a client that
+re-sends a call after a connection loss (RetryingRpcClient) gets the
+ORIGINAL outcome replayed instead of a second execution — submits and
+puts stay exactly-once across retries. Frames without a token (legacy
+peers, oneways) behave exactly as before.
+
+Fault tolerance layers here (see docs/fault_tolerance.md):
+``RetryingRpcClient`` wraps ``RpcClient`` with transparent reconnect
+(exponential backoff + jitter), per-call deadlines, and per-call
+idempotency tokens; the chaos plane (``chaos.py``) can drop / delay /
+duplicate / sever frames at the ``_send_frame`` / ``_recv_frame`` /
+``RpcServer._dispatch`` hook points to prove those layers work.
 
 Trust model (see ARCHITECTURE.md): payloads are pickles, so anyone who
 can complete the handshake can execute code in the receiving process.
@@ -35,12 +49,16 @@ import logging
 import os
 import pickle
 import queue
+import random
 import socket
 import socketserver
 import struct
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu._private import chaos
 
 logger = logging.getLogger(__name__)
 
@@ -153,10 +171,49 @@ class ProtocolError(ConnectionError):
     handshake."""
 
 
-def _send_frame(sock: socket.socket, obj, lock: Optional[threading.Lock]
-                ) -> None:
+def _frame_method(obj) -> str:
+    """Chaos-event label of a frame: the RPC method for call/oneway,
+    the topic for pushes, ``reply`` for replies."""
+    try:
+        kind = obj[0]
+        if kind == "call":
+            return obj[2]
+        if kind in ("oneway", "push"):
+            return obj[1]
+        return kind
+    except Exception:  # non-tuple frame (handshake errors etc.)
+        return ""
+
+
+def _hard_close(sock: socket.socket) -> None:
+    """Abrupt bidirectional teardown. shutdown() first: it wakes any
+    thread blocked in recv on this socket (a bare close can leave it
+    hanging); both steps tolerate an already-dead socket."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass    # already closed/reset: close below still applies
+    try:
+        sock.close()
+    except OSError:
+        pass    # already closed
+
+
+def _send_frame(sock: socket.socket, obj, lock: Optional[threading.Lock],
+                component: str = "") -> None:
+    dup = False
+    if chaos._plane.armed:
+        action = chaos.fire(component, "send", _frame_method(obj))
+        if action == "drop":
+            return
+        if action == "sever":
+            _hard_close(sock)
+            raise ConnectionError("chaos: connection severed at send")
+        dup = action == "dup"
     data = pickle.dumps(obj, protocol=5)
     frame = _HDR.pack(_MAGIC, len(data)) + data
+    if dup:
+        frame = frame + frame
     if lock is not None:
         with lock:
             sock.sendall(frame)
@@ -175,35 +232,118 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def _recv_frame(sock: socket.socket):
-    magic, length = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    if magic != _MAGIC:
-        if magic[:3] == _MAGIC[:3]:
-            raise ProtocolError(
-                f"peer protocol version {magic[3]} != {PROTOCOL_VERSION}")
-        raise ProtocolError(f"bad frame magic {magic!r}")
-    return pickle.loads(_recv_exact(sock, length))
+def _recv_frame(sock: socket.socket, component: str = ""):
+    while True:
+        magic, length = _HDR.unpack(_recv_exact(sock, _HDR.size))
+        if magic != _MAGIC:
+            if magic[:3] == _MAGIC[:3]:
+                raise ProtocolError(
+                    f"peer protocol version {magic[3]} != "
+                    f"{PROTOCOL_VERSION}")
+            raise ProtocolError(f"bad frame magic {magic!r}")
+        obj = pickle.loads(_recv_exact(sock, length))
+        if chaos._plane.armed:
+            action = chaos.fire(component, "recv", _frame_method(obj))
+            if action == "drop":
+                continue    # vanished in flight: read the next frame
+            if action == "sever":
+                _hard_close(sock)
+                raise ConnectionError(
+                    "chaos: connection severed at recv")
+        return obj
 
 
 class RpcError(Exception):
     """Remote handler raised; carries the remote exception."""
 
 
+class ConnectionLost(ConnectionError):
+    """This client's connection died while a call was in flight (the
+    reader thread injects it into every pending waiter). Distinct from
+    a ConnectionError RAISED BY the remote handler, which stays wrapped
+    in RpcError — only a genuine local loss is safe to retry."""
+
+
+class _DedupeCache:
+    """Idempotency-token -> recorded reply, bounded LRU.
+
+    ``begin`` claims a token: the FIRST claimant executes the handler
+    and must ``finish`` with the outcome; any later claimant (a retry
+    racing the original, or arriving after it) blocks until that
+    outcome exists and gets it replayed. This is what makes a client
+    re-send after connection loss exactly-once on the server."""
+
+    _PENDING = object()
+
+    def __init__(self, capacity: int):
+        self._capacity = max(2, capacity)
+        self._lock = threading.Lock()
+        # token -> (event, [outcome]) while pending, (None, [outcome])
+        # once finished; OrderedDict for LRU eviction of FINISHED entries
+        self._entries: "OrderedDict" = OrderedDict()  # guarded-by: _lock
+
+    def begin(self, token) -> Optional[tuple]:
+        """None = caller owns execution; else the recorded (ok, payload)
+        to replay (waits for an in-flight original to finish)."""
+        with self._lock:
+            entry = self._entries.get(token)
+            if entry is None:
+                self._entries[token] = (threading.Event(), [])
+                return None
+            self._entries.move_to_end(token)
+            event, box = entry
+        if event is not None:
+            # Original still executing on another thread; bounded wait —
+            # a wedged handler must not pin retry threads forever.
+            event.wait(timeout=60.0)
+        with self._lock:
+            entry = self._entries.get(token)
+        if entry is None or not entry[1]:
+            # evicted or still unfinished after the wait: degrade to
+            # re-execution (at-least-once beats a silent hang)
+            return None
+        return entry[1][0]
+
+    def finish(self, token, ok: bool, payload) -> None:
+        with self._lock:
+            entry = self._entries.get(token)
+            if entry is None:       # cleared/evicted mid-execution
+                self._entries[token] = (None, [(ok, payload)])
+            else:
+                entry[1].append((ok, payload))
+                if entry[0] is not None:
+                    entry[0].set()
+                self._entries[token] = (None, entry[1])
+            while len(self._entries) > self._capacity:
+                # evict the oldest FINISHED entry; never a pending one
+                for tok, (ev, _box) in self._entries.items():
+                    if ev is None:
+                        self._entries.pop(tok)
+                        break
+                else:
+                    break
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
 class ConnectionContext:
     """Server-side handle for one client connection; handlers may keep
     it to push messages later (completion callbacks, pubsub)."""
 
-    def __init__(self, sock: socket.socket, peer):
+    def __init__(self, sock: socket.socket, peer, component: str = ""):
         self._sock = sock
         self._send_lock = threading.Lock()
         self.peer = peer
+        self.component = component
         self.alive = True
         self.meta: Dict[str, Any] = {}   # handler scratch (e.g. node id)
 
     def push(self, topic: str, payload) -> bool:
         try:
             _send_frame(self._sock, ("push", topic, payload),
-                        self._send_lock)
+                        self._send_lock, component=self.component)
             return True
         except OSError:
             self.alive = False
@@ -216,27 +356,33 @@ class RpcServer:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None, component: str = "server"):
         self._handlers: Dict[str, Callable] = {}
         self._disconnect_cb: Optional[Callable[[ConnectionContext], None]] \
             = None
         self._live_lock = threading.Lock()
         self._live: set = set()
         self._token = token
+        self._component = component
+        from ray_tpu._private.config import get_config
+        self._dedupe = _DedupeCache(get_config().rpc_dedupe_cache_size)
+        self.dedupe_hits = 0        # replayed replies (observability)
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):  # noqa: ANN201
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                ctx = ConnectionContext(sock, self.client_address)
+                ctx = ConnectionContext(sock, self.client_address,
+                                        component=outer._component)
                 if not outer._handshake(sock):
                     return
                 with outer._live_lock:
                     outer._live.add(ctx)
                 try:
                     while True:
-                        msg = _recv_frame(sock)
+                        msg = _recv_frame(sock,
+                                          component=outer._component)
                         outer._dispatch(ctx, msg)
                 except (ConnectionError, OSError, EOFError):
                     pass
@@ -313,20 +459,48 @@ class RpcServer:
 
     def _dispatch(self, ctx: ConnectionContext, msg) -> None:
         kind = msg[0]
+        if chaos._plane.armed and kind in ("call", "oneway"):
+            action = chaos.fire(self._component, "dispatch",
+                                _frame_method(msg))
+            if action == "drop":
+                return      # request lost after delivery: caller times out
+            if action == "sever":
+                raise ConnectionError("chaos: connection severed at "
+                                      "dispatch")
+            if action == "dup":
+                # duplicated delivery: the dedupe cache (when the call
+                # carries an idempotency token) must collapse these
+                self._dispatch_one(ctx, msg)
+        self._dispatch_one(ctx, msg)
+
+    def _dispatch_one(self, ctx: ConnectionContext, msg) -> None:
+        kind = msg[0]
         if kind == "call":
-            _, req_id, method, args = msg
-            fn = self._handlers.get(method)
-            if fn is None:
-                reply = ("reply", req_id, False,
-                         f"unknown method {method!r}")
-            else:
-                try:
-                    reply = ("reply", req_id, True, fn(ctx, *args))
-                except Exception as e:  # noqa: BLE001 - ships to caller
-                    logger.debug("handler %s raised", method, exc_info=True)
-                    reply = ("reply", req_id, False, e)
+            req_id, method, args = msg[1], msg[2], msg[3]
+            idem = msg[4] if len(msg) > 4 else None
+            reply = None
+            if idem is not None:
+                recorded = self._dedupe.begin(idem)
+                if recorded is not None:
+                    self.dedupe_hits += 1
+                    reply = ("reply", req_id, recorded[0], recorded[1])
+            if reply is None:
+                fn = self._handlers.get(method)
+                if fn is None:
+                    ok, payload = False, f"unknown method {method!r}"
+                else:
+                    try:
+                        ok, payload = True, fn(ctx, *args)
+                    except Exception as e:  # noqa: BLE001 - ships to caller
+                        logger.debug("handler %s raised", method,
+                                     exc_info=True)
+                        ok, payload = False, e
+                if idem is not None:
+                    self._dedupe.finish(idem, ok, payload)
+                reply = ("reply", req_id, ok, payload)
             try:
-                _send_frame(ctx._sock, reply, ctx._send_lock)
+                _send_frame(ctx._sock, reply, ctx._send_lock,
+                            component=self._component)
             except OSError:
                 raise      # socket is gone; connection teardown handles it
             except Exception as e:  # unpicklable result or exception
@@ -335,7 +509,8 @@ class RpcServer:
                             ("reply", req_id, False,
                              RpcError(f"handler {method!r} returned/raised "
                                       f"an unserializable value: {e!r}")),
-                            ctx._send_lock)
+                            ctx._send_lock,
+                            component=self._component)
         elif kind == "oneway":
             _, method, args = msg
             fn = self._handlers.get(method)
@@ -359,14 +534,7 @@ class RpcServer:
         with self._live_lock:
             live = list(self._live)
         for ctx in live:
-            try:
-                ctx._sock.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                ctx._sock.close()
-            except OSError:
-                pass
+            _hard_close(ctx._sock)
 
 
 class RpcClient:
@@ -377,10 +545,12 @@ class RpcClient:
                  on_push: Optional[Callable[[str, Any], None]] = None,
                  connect_timeout: float = 10.0,
                  on_close: Optional[Callable[[], None]] = None,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 component: str = ""):
         self.address = tuple(address)
         self._on_push = on_push
         self._on_close = on_close
+        self._component = component
         self._sock = socket.create_connection(self.address,
                                               timeout=connect_timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -439,7 +609,7 @@ class RpcClient:
     def _read_loop(self) -> None:
         try:
             while True:
-                msg = _recv_frame(self._sock)
+                msg = _recv_frame(self._sock, component=self._component)
                 if msg[0] == "reply":
                     _, req_id, ok, payload = msg
                     with self._pending_lock:
@@ -457,8 +627,13 @@ class RpcClient:
             with self._pending_lock:
                 pending = list(self._pending.values())
                 self._pending.clear()
+            # ok=None marks a LOCALLY-injected loss: remote replies
+            # only ever carry ok True/False, so a handler-raised
+            # ConnectionLost shipped in a payload can never be
+            # mistaken for our own connection dying (it must surface
+            # as RpcError, not trigger a retry loop).
             for waiter in pending:
-                waiter.put((False, ConnectionError("connection lost")))
+                waiter.put((None, ConnectionLost("connection lost")))
             self._push_queue.put(None)
             if self._on_close is not None:
                 try:
@@ -467,7 +642,12 @@ class RpcClient:
                     logger.exception("rpc on_close callback failed")
 
     def call(self, method: str, *args,
-             timeout: Optional[float] = None):
+             timeout: Optional[float] = None,
+             idem: Optional[str] = None):
+        """Sync round-trip. ``idem``: idempotency token shipped with
+        the frame; a server that already executed a call with this
+        token replays the recorded reply (RetryingRpcClient passes the
+        same token across re-sends of one logical call)."""
         if not self.alive:
             raise ConnectionError("rpc connection closed")
         with self._pending_lock:
@@ -475,8 +655,17 @@ class RpcClient:
             req_id = self._req_counter
             waiter: queue.Queue = queue.Queue(maxsize=1)
             self._pending[req_id] = waiter
-        _send_frame(self._sock, ("call", req_id, method, args),
-                    self._send_lock)
+        frame = (("call", req_id, method, args) if idem is None
+                 else ("call", req_id, method, args, idem))
+        try:
+            _send_frame(self._sock, frame, self._send_lock,
+                        component=self._component)
+        except (ConnectionError, OSError) as e:
+            # Send failed: the waiter will never be answered — drop it
+            # before surfacing, or the entry leaks in _pending forever.
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            self._send_failed(method, e)
         try:
             ok, payload = waiter.get(timeout=timeout)
         except queue.Empty:
@@ -484,14 +673,35 @@ class RpcClient:
                 self._pending.pop(req_id, None)
             raise TimeoutError(
                 f"rpc call {method!r} timed out after {timeout}s") from None
+        if ok is None:
+            raise payload           # reader-injected: connection died
         if ok:
             return payload
         if isinstance(payload, BaseException):
             raise RpcError(str(payload)) from payload
         raise RpcError(str(payload))
 
+    def _send_failed(self, method: str, e: BaseException) -> None:
+        """Shared send-failure surface: a broken send means the socket
+        is done — tear the client down now (waiters drain, a retrying
+        wrapper stops handing out this connection) and surface a
+        ConnectionError, never a raw OSError. Always raises."""
+        self.close()
+        if isinstance(e, ConnectionError):
+            raise e
+        raise ConnectionError(
+            f"rpc send of {method!r} failed: {e}") from e
+
     def oneway(self, method: str, *args) -> None:
-        _send_frame(self._sock, ("oneway", method, args), self._send_lock)
+        """Fire-and-forget. Shares ``call``'s error surface: a dead or
+        dying connection raises ConnectionError, never a raw OSError."""
+        if not self.alive:
+            raise ConnectionError("rpc connection closed")
+        try:
+            _send_frame(self._sock, ("oneway", method, args),
+                        self._send_lock, component=self._component)
+        except (ConnectionError, OSError) as e:
+            self._send_failed(method, e)
 
     def close(self) -> None:
         self.alive = False
@@ -501,16 +711,321 @@ class RpcClient:
             pass    # already closed by the reader on EOF
 
 
+class RetryingRpcClient:
+    """Reconnecting facade over ``RpcClient``: transparent reconnect
+    with exponential backoff + jitter, per-call overall deadlines, and
+    per-call idempotency tokens (server-side dedupe makes re-sends
+    exactly-once). The GCS channel, the raylet->GCS channel, and the
+    owner->raylet lease channel all ride this.
+
+    Semantics:
+
+    - ``call`` owns a logical deadline (``timeout`` or the configured
+      ``rpc_call_deadline_ms``) spanning every reconnect and re-send.
+      Connection loss mid-call reconnects and re-sends the SAME token;
+      with ``attempt_timeout`` set, a silently dropped frame is also
+      re-sent after that slice instead of burning the whole deadline.
+    - ``on_reconnect(raw_client)`` runs after EVERY successful
+      handshake (including the first): re-subscribe, re-register —
+      whatever state the server side keeps per-connection. It receives
+      the RAW client and must talk through it (the wrapper's lock is
+      held). If it raises, the connect counts as failed and backoff
+      continues. ``on_restored()`` fires after a RE-connect only,
+      outside the lock — safe to call back into this wrapper (the
+      raylet re-registers its node with the GCS there).
+    - ``auto_reconnect=True`` restores the connection in the
+      background the moment it drops (pushes ride connections, so a
+      call-idle client would otherwise never notice); after
+      ``reconnect_window`` seconds of failure it calls ``on_give_up``
+      (the owner's raylet channel declares the node lost there).
+      ``reconnect_window=None`` keeps trying until ``close``.
+    """
+
+    def __init__(self, address: Tuple[str, int],
+                 on_push: Optional[Callable[[str, Any], None]] = None,
+                 token: Optional[str] = None,
+                 component: str = "client",
+                 on_reconnect: Optional[Callable[[RpcClient], None]] = None,
+                 on_restored: Optional[Callable[[], None]] = None,
+                 on_give_up: Optional[Callable[[BaseException], None]] = None,
+                 should_reconnect: Optional[Callable[[], bool]] = None,
+                 connect_timeout: float = 10.0,
+                 call_deadline: Optional[float] = None,
+                 attempt_timeout: Optional[float] = None,
+                 reconnect_window: Optional[float] = 0.0,
+                 auto_reconnect: bool = False,
+                 seed: Optional[int] = None):
+        from ray_tpu._private.config import get_config
+        cfg = get_config()
+        self.address = tuple(address)
+        self._on_push = on_push
+        self._token = token
+        self._component = component
+        self._on_reconnect = on_reconnect
+        self._on_restored = on_restored
+        self._on_give_up = on_give_up
+        # Consulted before every reconnect attempt: False = the peer
+        # can never answer (e.g. a spawned raylet process that already
+        # EXITED) — fail fast instead of burning the backoff window.
+        self._should_reconnect = should_reconnect
+        self._connect_timeout = connect_timeout
+        self._call_deadline = (call_deadline if call_deadline is not None
+                               else cfg.rpc_call_deadline_ms / 1000.0)
+        self._attempt_timeout = attempt_timeout
+        self._backoff_base = cfg.rpc_reconnect_backoff_base_ms / 1000.0
+        self._backoff_cap = cfg.rpc_reconnect_backoff_max_ms / 1000.0
+        self._reconnect_window = reconnect_window
+        self._auto_reconnect = auto_reconnect
+        self._rng = random.Random(seed)
+        self._lock = threading.RLock()
+        self._inner: Optional[RpcClient] = None  # guarded-by: _lock
+        # Background-reconnector handoff state. _bg_active is the
+        # LOGICAL liveness of the reconnector (flipped under _lock, so
+        # handoff can't race a thread that decided to exit but hasn't
+        # finished dying yet — Thread.is_alive() can't give that
+        # guarantee); _reconnect_needed latches close events that
+        # arrive while a reconnect round is already in flight.
+        self._bg_active = False  # guarded-by: _lock
+        self._reconnect_needed = False  # guarded-by: _lock
+        self._closed = False
+        self._ever_connected = False
+        self.num_reconnects = 0     # successful re-handshakes after the first
+        self._idem_prefix = os.urandom(8).hex()
+        self._idem_counter = 0      # guarded-by: _lock
+        # The first connect raises to the caller like a plain RpcClient
+        # (a server that never existed is a config error, not a blip).
+        with self._lock:
+            self._connect_locked()
+
+    # -- connection management ----------------------------------------
+
+    # lock-held: _lock
+    def _connect_locked(self, budget: Optional[float] = None
+                        ) -> RpcClient:
+        client = RpcClient(self.address, on_push=self._on_push,
+                           connect_timeout=(
+                               self._connect_timeout if budget is None
+                               else max(0.05, min(self._connect_timeout,
+                                                  budget))),
+                           on_close=self._on_inner_close,
+                           token=self._token, component=self._component)
+        first = self._inner is None and self.num_reconnects == 0
+        if self._on_reconnect is not None:
+            try:
+                self._on_reconnect(client)
+            except BaseException as e:
+                client.close()
+                if isinstance(e, ProtocolError):
+                    raise
+                # Whatever the hook raised (TimeoutError from a
+                # stalled peer, RpcError, ...), the CONNECT failed:
+                # normalize so the backoff loop keeps retrying instead
+                # of the raw error escaping mid-deadline.
+                raise ConnectionError(
+                    f"connection setup hook failed: {e}") from e
+        if not first:
+            self.num_reconnects += 1
+        self._inner = client
+        self._ever_connected = True
+        return client
+
+    def _get_client(self, deadline: float) -> RpcClient:
+        """The live inner client, reconnecting with backoff+jitter as
+        needed (bounded by ``deadline``)."""
+        delay = self._backoff_base
+        last: Optional[BaseException] = None
+        while True:
+            client = None
+            reconnected = False
+            with self._lock:
+                if self._closed:
+                    raise ConnectionError("rpc client closed")
+                if self._inner is not None and self._inner.alive:
+                    return self._inner
+                if (self._should_reconnect is not None
+                        and not self._should_reconnect()):
+                    raise ConnectionError(
+                        f"peer at {self.address} is gone for good "
+                        "(not retrying)")
+                budget = deadline - time.monotonic()
+                if budget > 0:
+                    reconnected = self._inner is not None \
+                        or self.num_reconnects > 0
+                    try:
+                        client = self._connect_locked(budget=budget)
+                    except ProtocolError:
+                        raise   # refused (token/version): never retryable
+                    except (ConnectionError, OSError) as e:
+                        last = e
+            if client is not None:
+                if reconnected and self._on_restored is not None:
+                    try:
+                        self._on_restored()
+                    except Exception:
+                        logger.exception("rpc on_restored callback "
+                                         "failed")
+                return client
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise ConnectionError(
+                    f"reconnect to {self.address} failed within "
+                    f"deadline: {last}") from last
+            # Backoff with half-jitter (delay/2 .. delay), clamped so
+            # one final connect attempt still fits before the deadline
+            # instead of giving up with most of a backoff step unused.
+            time.sleep(min(delay / 2 + self._rng.random() * delay / 2,
+                           max(0.001, remaining - 0.05)))
+            delay = min(delay * 2, self._backoff_cap)
+
+    def _on_inner_close(self) -> None:
+        # _ever_connected guards the half-built case: a failed
+        # __init__ (setup hook raised after the TCP handshake) closes
+        # its client, and the reader's on_close must not leave an
+        # immortal background reconnector serving an object nobody
+        # holds.
+        if (not self._auto_reconnect or self._closed
+                or not self._ever_connected):
+            return
+        spawn = None
+        with self._lock:
+            if self._closed:
+                return
+            self._reconnect_needed = True
+            if not self._bg_active:
+                self._bg_active = True
+                spawn = threading.Thread(
+                    target=self._background_reconnect, daemon=True,
+                    name=f"rtpu-rpc-reconnect-{self.address[1]}")
+                self._bg_thread = spawn
+        if spawn is not None:
+            spawn.start()
+
+    def _background_reconnect(self) -> None:
+        """One logical reconnector: rounds keep running while close
+        events latch _reconnect_needed (the restored connection can
+        die again while on_restored is still executing); the exit
+        decision and the _bg_active flip are one atomic step under
+        _lock, so a close event always finds either an active round
+        or a spawnable slot — never a dying thread it can't replace."""
+        while True:
+            with self._lock:
+                if self._closed or not self._reconnect_needed:
+                    self._bg_active = False
+                    return
+                self._reconnect_needed = False
+            window = self._reconnect_window
+            deadline = (time.monotonic() + window if window is not None
+                        else float("inf"))
+            try:
+                self._get_client(deadline)
+            except BaseException as e:  # noqa: BLE001 - routed to give-up
+                with self._lock:
+                    self._bg_active = False
+                if self._closed:
+                    return
+                logger.warning("rpc channel to %s not restored: %s",
+                               self.address, e)
+                if self._on_give_up is not None:
+                    try:
+                        self._on_give_up(e)
+                    except Exception:
+                        logger.exception("rpc give-up callback failed")
+                return
+            with self._lock:
+                if self._inner is None or not self._inner.alive:
+                    # died again before this round even finished
+                    self._reconnect_needed = True
+
+    # -- calls ---------------------------------------------------------
+
+    def _next_token(self) -> str:
+        with self._lock:
+            self._idem_counter += 1
+            return f"{self._idem_prefix}:{self._idem_counter}"
+
+    def call(self, method: str, *args,
+             timeout: Optional[float] = None,
+             idempotent: bool = True):
+        deadline = time.monotonic() + (
+            timeout if timeout is not None else self._call_deadline)
+        token = self._next_token() if idempotent else None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"rpc call {method!r} deadline exceeded")
+            client = self._get_client(deadline)
+            slice_t = remaining
+            if self._attempt_timeout is not None and token is not None:
+                slice_t = min(remaining, self._attempt_timeout)
+            try:
+                return client.call(method, *args, timeout=slice_t,
+                                   idem=token)
+            except TimeoutError:
+                if slice_t >= remaining:
+                    raise           # the overall deadline is spent
+                continue            # idempotent re-send, same token
+            except ProtocolError:
+                raise
+            except ConnectionLost:
+                if token is None:
+                    # frame was on the wire and may have executed; a
+                    # tokenless re-send could double-execute — surface
+                    raise
+                continue
+            except ConnectionError:
+                continue            # nothing sent: reconnect + retry
+
+    def oneway(self, method: str, *args) -> None:
+        """Best-effort send; one transparent reconnect+resend. Loss
+        tolerated by every oneway user (heartbeats, releases)."""
+        for attempt in (0, 1):
+            try:
+                client = self._get_client(time.monotonic() + 5.0)
+                client.oneway(method, *args)
+                return
+            except ProtocolError:
+                raise
+            except ConnectionError:
+                if attempt:
+                    raise
+
+    # -- lifecycle -----------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        with self._lock:
+            return (not self._closed and self._inner is not None
+                    and self._inner.alive)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            inner = self._inner
+        if inner is not None:
+            inner.close()
+
+
 def wait_for_server(address: Tuple[str, int], timeout: float = 10.0) -> None:
-    """Block until a server accepts connections at ``address``."""
+    """Block until a server accepts connections at ``address``.
+    Exponential backoff between probes (20ms doubling to 500ms); each
+    probe's connect timeout is clamped to the remaining deadline."""
     deadline = time.monotonic() + timeout
     last: Optional[Exception] = None
-    while time.monotonic() < deadline:
+    delay = 0.02
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(f"no rpc server at {address}: {last}")
         try:
-            sock = socket.create_connection(tuple(address), timeout=1.0)
+            sock = socket.create_connection(tuple(address),
+                                            timeout=min(1.0, remaining))
             sock.close()
             return
         except OSError as e:
             last = e
-            time.sleep(0.05)
-    raise TimeoutError(f"no rpc server at {address}: {last}")
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(f"no rpc server at {address}: {last}")
+        time.sleep(min(delay, remaining))
+        delay = min(delay * 2, 0.5)

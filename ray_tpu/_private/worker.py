@@ -112,6 +112,8 @@ class Worker:
         cfg = get_config()
         if _system_config:
             cfg.apply_system_config(_system_config)
+        from ray_tpu._private import chaos
+        chaos.maybe_arm()   # RTPU_CHAOS / chaos_rules fault injection
         self._join_address = None
         if address:
             host, port = address.rsplit(":", 1)

@@ -75,6 +75,8 @@ class ProcessWorker(BaseWorker):
                  python_exe: Optional[str] = None,
                  env_tag: Optional[str] = None):
         super().__init__()
+        from ray_tpu._private import chaos
+        chaos.fire("worker_pool", "spawn")
         self.conn = None
         self._on_ready = on_ready
         # pip runtime env: exec the venv's interpreter; the pool keeps
@@ -127,6 +129,8 @@ class ProcessWorker(BaseWorker):
         self.conn.send(msg)
 
     def kill(self) -> None:
+        from ray_tpu._private import chaos
+        chaos.fire("worker_pool", "teardown")
         self.alive = False
         try:
             self.proc.terminate()

@@ -363,6 +363,11 @@ class ExecutionEnv:
         ``emit`` ships incremental ("stream", ...) messages for
         streaming generator tasks."""
         import time as _time
+        from ray_tpu._private import chaos
+        # chaos kill-at-point: a `worker.exec.<task-name>:kill` rule
+        # dies HERE — after the payload reached this worker, before any
+        # user code ran (the mid-task worker-death failure mode).
+        chaos.fire("worker", "exec", payload.get("name", ""))
         task_id = payload["task_id"]
         t_start = _time.perf_counter()
         # Expose the owner channel + identity to nested API calls made
@@ -839,6 +844,10 @@ def worker_main(conn, session: str, max_inline_bytes: int,
     """
     if env_vars:
         os.environ.update(env_vars)
+
+    from ray_tpu._private import chaos
+    chaos.maybe_arm()
+    chaos.fire("worker", "boot")
 
     if os.environ.get("RTPU_WORKER_PROFILE"):
         # Debug: cProfile this worker's whole loop, dumped at exit —

@@ -10,8 +10,9 @@ def load_passes() -> List:
         async_blocking,
         lock_discipline,
         ref_leak,
+        retry_discipline,
         rpc_surface,
         silent_exception,
     )
     return [lock_discipline, async_blocking, rpc_surface,
-            silent_exception, ref_leak]
+            silent_exception, ref_leak, retry_discipline]

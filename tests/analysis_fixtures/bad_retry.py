@@ -1,0 +1,23 @@
+"""retry-discipline fixture: one deadline-less literal call site, one
+deadlined, one comment-suppressed, one variable-method wrapper."""
+
+
+class Courier:
+    def __init__(self, client):
+        self._client = client
+
+    def bad(self):
+        # flagged: literal method, no timeout, no annotation
+        return self._client.call("fetch_state")
+
+    def good(self):
+        return self._client.call("fetch_state", timeout=5.0)
+
+    def blocking_by_design(self):
+        return self._client.call(
+            "wait_forever")  # no-deadline: returns only when work exists
+
+    def wrapper(self, method, *args, **kwargs):
+        # variable method: the wrapper seam is exempt (its literal
+        # callers are checked instead)
+        return self._client.call(method, *args, **kwargs)

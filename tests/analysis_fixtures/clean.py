@@ -27,7 +27,7 @@ class Poller:
 
 def build(server, client):
     server.register("do_work", lambda ctx: None)
-    return client.call("do_work")
+    return client.call("do_work", timeout=5.0)
 
 
 def risky(fn):

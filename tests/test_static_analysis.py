@@ -81,6 +81,31 @@ def test_ref_leak_flags_dead_and_discarded_refs():
     assert "discarded" in messages                 # bare expression
 
 
+def test_retry_discipline_flags_deadlineless_call():
+    unsuppressed, _ = _run([_fixture("bad_retry.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "retry-discipline"]
+    assert len(hits) == 1
+    assert "'fetch_state'" in hits[0].message
+    assert hits[0].context == "Courier.bad"
+
+
+def test_retry_discipline_scoped_to_private_tree(tmp_path):
+    """Outside _private/ (and the fixture tree) the pass stays quiet:
+    library layers talk through already-deadlined seams."""
+    mod = tmp_path / "lib.py"
+    mod.write_text("def f(c):\n    return c.call('x')\n")
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path))
+    assert [f for f in unsuppressed
+            if f.pass_id == "retry-discipline"] == []
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    mod2 = priv / "lib.py"
+    mod2.write_text("def f(c):\n    return c.call('x')\n")
+    unsuppressed, _ = _run([str(mod2)], root=str(tmp_path))
+    assert len([f for f in unsuppressed
+                if f.pass_id == "retry-discipline"]) == 1
+
+
 def test_clean_fixture_produces_zero_findings():
     unsuppressed, all_findings = _run([_fixture("clean.py")])
     assert all_findings == [], [f.render() for f in all_findings]
