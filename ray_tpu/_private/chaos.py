@@ -23,7 +23,10 @@ A **rule** names an event and an action::
 - ``action``: ``drop`` (frame vanishes), ``delay=SECONDS`` (stall),
   ``dup`` (frame or dispatch happens twice), ``sever`` (the
   connection dies mid-flight), ``kill`` (the process exits
-  ``KILL_EXIT_CODE`` at the event — the chaos analog of kill -9).
+  ``KILL_EXIT_CODE`` at the event — the chaos analog of kill -9),
+  ``pressure=FRACTION`` (inject a synthetic memory-usage reading at
+  the raylet watchdog's ``sample`` point — OOM paths become
+  deterministically testable without real memory exhaustion).
 - ``@after``: fire on the Nth *matching* event (1-based, default 1);
   earlier matches count but pass through.
 - ``xCount``: keep firing for this many consecutive matches
@@ -68,9 +71,9 @@ ENV_SEED_VAR = "RTPU_CHAOS_SEED"
 # reading a raylet log) can tell an injected death from a real crash.
 KILL_EXIT_CODE = 42
 
-ACTIONS = ("drop", "delay", "dup", "sever", "kill")
+ACTIONS = ("drop", "delay", "dup", "sever", "kill", "pressure")
 POINTS = ("send", "recv", "dispatch", "spawn", "teardown", "boot",
-          "exec", "*")
+          "exec", "watchdog", "*")
 
 _RULE_RE = re.compile(
     r"^(?P<component>[^.:\s]+)\.(?P<point>[^.:\s]+)\.(?P<method>[^:\s]*)"
@@ -187,8 +190,18 @@ class ChaosPlane:
         """Evaluate one event. Returns the action the HOOK SITE must
         apply (``drop`` / ``dup`` / ``sever``) or None to proceed
         normally; ``delay`` sleeps here and ``kill`` exits here."""
+        return self.fire_arg(component, point, method)[0]
+
+    def fire_arg(self, component: str, point: str, method: str = ""
+                 ) -> Tuple[Optional[str], float]:
+        """Like ``fire`` but returns ``(action, arg)`` for hook sites
+        whose action carries a value — ``pressure`` injects ``arg`` as
+        a synthetic memory-usage fraction into the raylet watchdog
+        (``raylet.watchdog.sample*:pressure=0.97``; the watchdog's
+        event method is ``sampleN`` with N = killable-candidate
+        count, so ``sample2`` targets exactly-two-victims samples)."""
         if not self.armed:
-            return None
+            return None, 0.0
         action = None
         arg = 0.0
         with self._lock:
@@ -209,10 +222,10 @@ class ChaosPlane:
                 self.events.append((component, point, method, action))
                 break
         if action is None:
-            return None
+            return None, 0.0
         if action == "delay":
             time.sleep(arg)
-            return None
+            return None, 0.0
         if action == "kill":
             logger.warning("chaos: kill at %s.%s.%s (pid %d)",
                            component, point, method, os.getpid())
@@ -221,7 +234,7 @@ class ChaosPlane:
             os._exit(KILL_EXIT_CODE)
         logger.warning("chaos: %s at %s.%s.%s", action, component,
                        point, method)
-        return action
+        return action, arg
 
 
 _plane = ChaosPlane()
@@ -240,6 +253,15 @@ def fire(component: str, point: str, method: str = "") -> Optional[str]:
     if not _plane.armed:
         return None
     return _plane.fire(component, point, method)
+
+
+def fire_arg(component: str, point: str, method: str = ""
+             ) -> Tuple[Optional[str], float]:
+    """(action, arg) hook entry for value-carrying actions
+    (``pressure``); cheap no-op while unarmed."""
+    if not _plane.armed:
+        return None, 0.0
+    return _plane.fire_arg(component, point, method)
 
 
 def install(rules: Union[str, Sequence], seed: Optional[int] = None

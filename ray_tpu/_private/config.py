@@ -169,6 +169,44 @@ _D("raylet_channel_reconnect_ms", int, 3000,
    "reconnect after a connection loss before the node is declared "
    "lost (its tasks then retry on survivors).")
 
+# --- overload plane (reference: memory monitor + backpressured
+# submission; see docs/fault_tolerance.md "Overload semantics") ---
+_D("raylet_max_queued_tasks", int, 4096,
+   "Bounded raylet scheduler intake: submits beyond this many queued "
+   "payloads are shed with a retryable BackpressureError instead of "
+   "queuing without limit. 0 disables the bound.")
+_D("raylet_inflight_window", int, 1024,
+   "Owner-side cap on submitted-but-uncompleted normal-task leases "
+   "per remote raylet; excess dispatches wait briefly and retry. "
+   "0 disables the window.")
+_D("backpressure_retry_base_ms", int, 50,
+   "Initial delay before re-submitting a shed task; doubles per "
+   "consecutive shed (seeded jitter applied).")
+_D("backpressure_retry_max_ms", int, 2000,
+   "Shed-retry backoff ceiling.")
+_D("owner_max_pending_tasks", int, 0,
+   "Bounded nested-submission intake at the owner: nested_submit "
+   "calls arriving while this many submitted tasks are queued but "
+   "not yet executing are shed with BackpressureError (the in-worker "
+   "client retries with backoff). Executing tasks don't count — "
+   "blocked parents must stay able to submit the children they wait "
+   "on. 0 disables the bound.")
+_D("memory_watchdog_threshold", float, 0.95,
+   "Node memory usage fraction above which the raylet's watchdog "
+   "kills the largest retryable running task. The fraction is "
+   "whole-host usage ((MemTotal - MemAvailable) / MemTotal) by "
+   "default, or this raylet's own footprint (process-tree RSS + "
+   "object-store bytes) over memory_watchdog_total_bytes when that "
+   "is set. <= 0 disables the watchdog.")
+_D("memory_watchdog_total_bytes", int, 0,
+   "Explicit denominator of the watchdog usage fraction (containers, "
+   "tests); 0 = host mode, reading whole-host usage from "
+   "/proc/meminfo.")
+_D("task_oom_retries", int, 3,
+   "Owner-side retry budget for tasks killed by the memory watchdog "
+   "(separate from max_retries; exponential backoff between "
+   "attempts).")
+
 # --- chaos / fault injection (tests only; see _private/chaos.py) ---
 _D("chaos_rules", str, "",
    "Fault-injection rules (component.point.method:action[...]; "

@@ -62,6 +62,10 @@ class GcsServer:
         self._health_lock = threading.Lock()
         self._node_addrs: Dict[NodeID, Tuple[str, int]] = {}
         self._health_fails: Dict[NodeID, int] = {}
+        # health-probe clients, owned by the health thread; kept as an
+        # attribute (not a loop local) so dead nodes' clients are
+        # provably closed and pruned, not leaked
+        self._health_clients: Dict[NodeID, RpcClient] = {}
         self._shutdown = threading.Event()
 
         self.server = RpcServer(host, port, component="gcs")
@@ -138,13 +142,22 @@ class GcsServer:
             if not self._dirty.is_set():
                 continue
             self._dirty.clear()
-            try:
-                tmp = self._persist_path + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(self.state.dump_state())
-                os.replace(tmp, self._persist_path)
-            except Exception:
-                logger.exception("gcs persistence write failed")
+            self._write_snapshot()
+        # Final flush: a mutation that landed after the last snapshot
+        # but before shutdown must not be silently discarded — the
+        # persist_path's whole point is surviving the restart.
+        if self._dirty.is_set():
+            self._dirty.clear()
+            self._write_snapshot()
+
+    def _write_snapshot(self) -> None:
+        try:
+            tmp = self._persist_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(self.state.dump_state())
+            os.replace(tmp, self._persist_path)
+        except Exception:
+            logger.exception("gcs persistence write failed")
 
     # -- handlers ------------------------------------------------------
 
@@ -201,10 +214,15 @@ class GcsServer:
         cfg = get_config()
         period = cfg.health_check_period_ms / 1000.0
         threshold = cfg.health_check_failure_threshold
-        clients: Dict[NodeID, RpcClient] = {}
+        clients = self._health_clients
         while not self._shutdown.wait(period):
             with self._health_lock:
                 targets = dict(self._node_addrs)
+            # Prune clients of removed/declared-dead nodes: an
+            # unpruned entry leaks a socket (and its reader thread)
+            # per departed node for the lifetime of the GCS.
+            for node_id in [n for n in clients if n not in targets]:
+                clients.pop(node_id).close()
             for node_id, addr in targets.items():
                 ok = False
                 try:
@@ -235,13 +253,29 @@ class GcsServer:
                 if declare_dead:
                     logger.warning("node %s failed %d health checks; "
                                    "declaring dead", node_id, threshold)
+                    dead_client = clients.pop(node_id, None)
+                    if dead_client is not None:
+                        dead_client.close()
                     self.state.remove_node(node_id)
         for client in clients.values():
             client.close()
+        clients.clear()
 
     def shutdown(self) -> None:
-        self._shutdown.set()
+        # Server down FIRST: once _shutdown is set the persist thread
+        # may run its final flush at any moment, so no mutating
+        # handler may still be acknowledging writes past it.
         self.server.shutdown()
+        self._shutdown.set()
+        if self._persist_path:
+            # The persist thread's exit path flushes any pending dirty
+            # state; join it so an embedded GcsServer (tests, and the
+            # process entrypoint's finally) never drops the final
+            # snapshot on the floor.
+            try:
+                self._persist_thread.join(timeout=2.0)
+            except Exception:
+                pass    # never started / already gone
 
 
 # ---------------------------------------------------------------------------
@@ -256,10 +290,15 @@ def main(argv=None) -> None:
                    help="serialized system config json")
     p.add_argument("--persist-path", default="",
                    help="snapshot state to this file; reload on start")
+    p.add_argument("--port", type=int, default=0,
+                   help="bind to this port (0 = ephemeral); a restart "
+                        "against the same persist path reuses the old "
+                        "port so retrying clients reconnect unchanged")
     args = p.parse_args(argv)
     if args.config:
         get_config().load_serialized(args.config)
-    server = GcsServer(persist_path=args.persist_path or None)
+    server = GcsServer(port=args.port,
+                       persist_path=args.persist_path or None)
     tmp = args.port_file + ".tmp"
     with open(tmp, "w") as f:
         f.write(f"{server.address[0]}:{server.address[1]}")
@@ -274,9 +313,12 @@ def main(argv=None) -> None:
 
 
 def spawn_gcs_process(session: str, config_json: str = "",
-                      persist: bool = False
+                      persist: bool = False, port: int = 0
                       ) -> Tuple["subprocess.Popen", Tuple[str, int]]:
-    """Start a GCS server as a detached process; returns (proc, addr)."""
+    """Start a GCS server as a detached process; returns (proc, addr).
+    ``port``: bind there instead of an ephemeral port — restarting a
+    killed GCS on its OLD port lets every retrying client (raylets,
+    the driver) reconnect without re-discovery."""
     import subprocess
     d = os.path.join("/tmp", f"rtpu_{session}")
     os.makedirs(d, exist_ok=True)
@@ -293,6 +335,8 @@ def spawn_gcs_process(session: str, config_json: str = "",
     log = open(os.path.join(d, "gcs.log"), "ab")
     cmd = [sys.executable, "-m", "ray_tpu._private.gcs_server",
            "--port-file", port_file, "--config", config_json]
+    if port:
+        cmd += ["--port", str(port)]
     if persist:
         cmd += ["--persist-path", os.path.join(d, "gcs_state.bin")]
     proc = subprocess.Popen(cmd, env=env, start_new_session=True,

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
@@ -32,7 +33,11 @@ from ray_tpu._private import serialization
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.task_spec import FunctionDescriptor, TaskOptions
-from ray_tpu.exceptions import GetTimeoutError, TaskError
+from ray_tpu.exceptions import (
+    BackpressureError,
+    GetTimeoutError,
+    TaskError,
+)
 
 # Deadlines on the nested control protocol (retry-discipline): these
 # are owner round trips that answer promptly on a live driver — only
@@ -75,6 +80,39 @@ class NestedClient:
         self._fn_lock = threading.Lock()
         self._shipped_fids: set = set()
         self._fn_blobs: Dict[bytes, bytes] = {}
+        from ray_tpu._private.backoff import make_rng
+        self._bp_lock = threading.Lock()
+        self._bp_rng = make_rng()  # guarded-by: _bp_lock
+
+    def _backpressured_call(self, method: str, *args,
+                            timeout: float):
+        """One logical owner call that honors shed replies: a
+        BackpressureError (RESOURCE_EXHAUSTED frame) re-sends after a
+        jittered exponential backoff, all inside ``timeout``."""
+        from ray_tpu._private.backoff import jittered, next_backoff
+        from ray_tpu._private.config import get_config
+        cfg = get_config()
+        deadline = time.monotonic() + timeout
+        base = cfg.backpressure_retry_base_ms / 1000.0
+        cap = cfg.backpressure_retry_max_ms / 1000.0
+        delay = 0.0
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise BackpressureError(
+                    f"owner kept shedding {method!r} past the "
+                    f"{timeout}s deadline")
+            try:
+                return self._client.call(method, *args,
+                                         timeout=max(0.05, remaining))
+            except BackpressureError as e:
+                delay = next_backoff(delay, base, cap,
+                                     hint_s=e.backoff_s)
+                with self._bp_lock:
+                    wait = jittered(delay, self._bp_rng)
+                if time.monotonic() + wait >= deadline:
+                    raise
+                time.sleep(wait)
 
     # -- functions -----------------------------------------------------
 
@@ -118,7 +156,7 @@ class NestedClient:
         options_dict = {f: getattr(options, f)
                         for f in _SHIPPED_OPTION_FIELDS}
         fid = fn_descriptor.function_id
-        refs_b = self._client.call(
+        refs_b = self._backpressured_call(
             "nested_submit", fid, self._fn_shipment(fid),
             fn_descriptor.name, arg_descs, kwargs_keys, options_dict,
             timeout=_SHIP_TIMEOUT)

@@ -37,7 +37,11 @@ from ray_tpu._private.scheduler.resources import (
 )
 from ray_tpu._private.task_spec import TaskSpec, TaskType
 from ray_tpu._private.worker_pool import BaseWorker, ProcessWorker, WorkerPool
-from ray_tpu.exceptions import WorkerCrashedError
+from ray_tpu.exceptions import (
+    BackpressureError,
+    OutOfMemoryError,
+    WorkerCrashedError,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -104,6 +108,8 @@ class Raylet:
         self.worker_pool = WorkerPool(session, hub, reply_handler,
                                       on_worker_ready,
                                       max_process_workers=max_process_workers)
+        # unbounded-ok: fed only by the scheduler after a successful
+        # capacity allocation — depth is bounded by node resources
         self.dispatch_queue: deque = deque()
         self.alive = True
 
@@ -248,6 +254,9 @@ class NodeManagerGroup:
         self._remote_nodes: Dict[NodeID, RemoteNodeHandle] = {}  # guarded-by: _lock
         self._object_locations: Dict[ObjectID, NodeID] = {}  # guarded-by: _lock
         self._waiting: Dict[TaskID, TaskSpec] = {}  # guarded-by: _lock
+        # unbounded-ok: owner intake; nested submissions are bounded by
+        # owner_max_pending_tasks (shed with BackpressureError), the
+        # local driver's own burst is its own flow control
         self._to_schedule: deque = deque()  # guarded-by: _lock
         self._infeasible: Dict[TaskID, TaskSpec] = {}  # guarded-by: _lock
         self._running: Dict[TaskID, RunningTask] = {}  # guarded-by: _lock
@@ -258,6 +267,17 @@ class NodeManagerGroup:
         self._shutdown = False
         # bumped on node add/remove
         self._membership_version = 0  # guarded-by: _lock
+        # Overload plane, owner side: shed/OOM'd specs wait out their
+        # backoff here as (due_monotonic, spec, resubmit) — the
+        # scheduling loop pumps due entries back in. RNG seeding
+        # semantics live in backoff.make_rng.
+        from ray_tpu._private.backoff import make_rng
+        self._deferred: List[Tuple[float, TaskSpec, bool]] = []  # guarded-by: _lock
+        self._shed_rng = make_rng()  # guarded-by: _lock
+        self.num_shed = 0          # shed replies honored (cumulative)
+        self.num_window_waits = 0  # dispatches parked on a full window
+        # (timestamp, counts) memo for _remote_inflight_counts
+        self._inflight_cache: Tuple[float, Dict[NodeID, int]] = (-1.0, {})  # guarded-by: _lock
 
         from ray_tpu._private.connection_hub import ConnectionHub
         self.hub = ConnectionHub(session)
@@ -441,13 +461,153 @@ class NodeManagerGroup:
         else:
             self._complete_task(spec.task_id, [], None, err)
 
+    # How long a dispatch parked on a full in-flight window waits
+    # before rescheduling (flat — the window drains on completions,
+    # unlike a shed, which signals a raylet-side backlog).
+    _WINDOW_RETRY_S = 0.05
+
+    # Dispatch-path reads of the in-flight counts tolerate this much
+    # staleness: the window is flow control, not an invariant, and an
+    # off-by-a-few for 20ms beats an O(running) rescan per task (the
+    # pg-task and shed-redispatch paths dispatch one task at a time).
+    _INFLIGHT_CACHE_TTL = 0.02
+
+    def _remote_inflight_counts(self, max_age: float = _INFLIGHT_CACHE_TTL
+                                ) -> Dict[NodeID, int]:
+        """node -> submitted-but-uncompleted normal-task leases, ONE
+        pass over _running (derived, so the counts can never drift),
+        memoized for ``max_age`` seconds (0 = always fresh)."""
+        now = time.monotonic()
+        with self._lock:
+            ts, counts = self._inflight_cache
+            if now - ts <= max_age:
+                return counts
+            counts = {}
+            for rt in self._running.values():
+                if isinstance(rt.worker, _RemoteLease):
+                    counts[rt.node_id] = counts.get(rt.node_id, 0) + 1
+            self._inflight_cache = (now, counts)
+            return counts
+
+    def _remote_inflight(self, node_id: NodeID,
+                         max_age: float = 0.0) -> int:
+        return self._remote_inflight_counts(max_age).get(node_id, 0)
+
+    def _window_room(self, handle: RemoteNodeHandle) -> Optional[int]:
+        """Free in-flight-window slots on ``handle``; None = unlimited."""
+        window = get_config().raylet_inflight_window
+        if window <= 0:
+            return None
+        return max(0, window - self._remote_inflight(
+            handle.node_id, max_age=self._INFLIGHT_CACHE_TTL))
+
+    def _unwind_remote(self, handle: RemoteNodeHandle,
+                       spec: TaskSpec) -> None:
+        """Drop the (possibly not-yet-recorded) running record and
+        return the scheduler allocation — the shared unwind of every
+        not-actually-submitted remote path (requeue, shed, window)."""
+        with self._lock:
+            self._running.pop(spec.task_id, None)
+        self._free_allocation(handle.node_id, spec.resources,
+                              self._spec_pg(spec))
+
+    def _defer_spec(self, spec: TaskSpec, delay: float,
+                    resubmit: bool = False) -> None:
+        with self._lock:
+            self._deferred.append(
+                (time.monotonic() + max(0.0, delay), spec, resubmit))
+
+    def _defer_shed(self, handle: RemoteNodeHandle, spec: TaskSpec,
+                    hint_s: float = 0.0) -> None:
+        """Honor a shed reply: unwind the submission and park the spec
+        for a jittered, exponentially growing backoff (the raylet's
+        depth-scaled ``hint_s`` winning when larger) — a saturated
+        cluster costs latency, never results."""
+        from ray_tpu._private.backoff import jittered, next_backoff
+        self._unwind_remote(handle, spec)
+        cfg = get_config()
+        nxt = next_backoff(
+            getattr(spec, "_shed_backoff_s", 0.0),
+            cfg.backpressure_retry_base_ms / 1000.0,
+            cfg.backpressure_retry_max_ms / 1000.0,
+            hint_s=hint_s)
+        spec._shed_backoff_s = nxt  # type: ignore[attr-defined]
+        with self._lock:
+            self.num_shed += 1
+            delay = jittered(nxt, self._shed_rng)
+        self._defer_spec(spec, delay)
+
+    def _defer_window(self, handle: RemoteNodeHandle,
+                      spec: TaskSpec) -> None:
+        self._unwind_remote(handle, spec)
+        with self._lock:
+            self.num_window_waits += 1
+        self._defer_spec(spec, self._WINDOW_RETRY_S)
+
+    def _pump_deferred(self) -> None:
+        """Move due deferred specs back into scheduling (runs on the
+        scheduling loop's tick)."""
+        now = time.monotonic()
+        due: List[Tuple[float, TaskSpec, bool]] = []
+        with self._lock:
+            if not self._deferred:
+                return
+            keep = []
+            for item in self._deferred:
+                (due if item[0] <= now else keep).append(item)
+            self._deferred[:] = keep
+        # Cancellation can land while a spec is parked (cancel_queued
+        # scans _deferred, but a cancel racing this pump's pop would
+        # miss): re-check the flag before re-entering scheduling.
+        cancelled: List[TaskSpec] = []
+        if self._cancelled_check is not None:
+            live, cancelled = [], []
+            for item in due:
+                (cancelled if self._cancelled_check(item[1].task_id)
+                 else live).append(item)
+            due = live
+        resubmits = [s for _t, s, r in due if r]
+        schedule = [s for _t, s, r in due if not r]
+        for spec in resubmits:
+            # full resubmission (OOM retry): deps re-checked
+            self.submit_task(spec)
+        if schedule:
+            # one acquisition for the whole wave, not one per spec
+            with self._lock:
+                self._to_schedule.extend(schedule)
+        for item in cancelled:
+            from ray_tpu.exceptions import TaskCancelledError
+            spec = item[1]
+            self._complete_task(spec.task_id, [], None,
+                                TaskCancelledError(
+                                    f"task {spec.repr_name()} was "
+                                    "cancelled"))
+        if due or cancelled:
+            self._wake.set()
+
+    def submit_task_after(self, spec: TaskSpec, delay: float) -> None:
+        """Submit ``spec`` after ``delay`` seconds (the OOM retry's
+        exponential backoff rides this)."""
+        self._defer_spec(spec, delay, resubmit=True)
+
     def _dispatch_remote_batch(self, handle: RemoteNodeHandle,
                                specs: List[TaskSpec]) -> None:
         """One lease RPC for N tasks bound for the same raylet (the
         submit half of the remote wire path; statuses come back per
         payload so spillback refusals stay per-task)."""
+        room = self._window_room(handle)
+        if room is not None and len(specs) > room:
+            # Capped in-flight submission window: the overflow waits
+            # briefly instead of piling onto an already-loaded raylet.
+            for spec in specs[room:]:
+                self._defer_window(handle, spec)
+            specs = specs[:room]
+            if not specs:
+                return
         if len(specs) == 1:
-            self._dispatch_remote(handle, specs[0])
+            # window already checked above — don't rescan _running
+            self._dispatch_remote(handle, specs[0],
+                                  window_checked=True)
             return
         sendable: List[Tuple[TaskSpec, dict]] = []
         batch_shipped: set = set()
@@ -465,6 +625,11 @@ class NodeManagerGroup:
                 self._running[spec.task_id] = RunningTask(
                     spec, handle.node_id, _RemoteLease(handle),
                     dict(spec.resources), pg=self._spec_pg(spec))
+            # new leases recorded: the memoized in-flight counts are
+            # stale NOW, not in 20ms — without this, back-to-back
+            # wake-driven ticks could overshoot the window by a full
+            # batch per tick
+            self._inflight_cache = (-1.0, {})
         # Timeout scales with the frame: the single-lease bound is
         # sized for one payload, and an N-task frame's transfer time
         # grows with N — timing out a frame the raylet already
@@ -493,8 +658,22 @@ class NodeManagerGroup:
             if status == "refused":
                 self._requeue_remote(handle, spec)
                 requeued = True
+            elif status == "shed" or (
+                    isinstance(status, (list, tuple)) and status
+                    and status[0] == "shed"):
+                # bounded intake full: retry after a jittered backoff,
+                # honoring the raylet's depth-scaled suggestion when
+                # the frame carries one
+                self._defer_shed(
+                    handle, spec,
+                    hint_s=(float(status[1])
+                            if isinstance(status, (list, tuple))
+                            and len(status) > 1 else 0.0))
             else:
                 accepted.append(payload)
+                # admitted: a LATER shed (e.g. after a crash retry)
+                # starts its backoff from base again, not the stale cap
+                spec._shed_backoff_s = 0.0  # type: ignore[attr-defined]
                 events.record(spec.task_id.hex(), spec.repr_name(),
                               "RUNNING",
                               worker=f"node:{handle.node_id.hex()[:8]}")
@@ -507,16 +686,20 @@ class NodeManagerGroup:
         """Unwind one remote submission (frame lost / spillback
         refusal): drop the running record, return the allocation,
         requeue for scheduling."""
-        with self._lock:
-            self._running.pop(spec.task_id, None)
-        self._free_allocation(handle.node_id, spec.resources,
-                              self._spec_pg(spec))
+        self._unwind_remote(handle, spec)
         with self._lock:
             self._to_schedule.append(spec)
 
-    def _dispatch_remote(self, handle: RemoteNodeHandle, spec: TaskSpec
-                         ) -> None:
-        """Ship a scheduled task to a remote raylet (lease+exec)."""
+    def _dispatch_remote(self, handle: RemoteNodeHandle, spec: TaskSpec,
+                         window_checked: bool = False) -> None:
+        """Ship a scheduled task to a remote raylet (lease+exec).
+        ``window_checked``: the caller already ran the in-flight-window
+        check for this dispatch (the batch path) — skip the rescan."""
+        if not window_checked:
+            room = self._window_room(handle)
+            if room is not None and room <= 0:
+                self._defer_window(handle, spec)
+                return
         payload, err = self._build_remote_payload(handle, spec)
         if err is not None:
             self._handle_remote_build_error(handle, spec, err)
@@ -525,10 +708,15 @@ class NodeManagerGroup:
             self._running[spec.task_id] = RunningTask(
                 spec, handle.node_id, _RemoteLease(handle),
                 dict(spec.resources), pg=self._spec_pg(spec))
+            self._inflight_cache = (-1.0, {})   # see batch path
         lease_timeout = get_config().worker_lease_timeout_ms / 1000.0
         try:
             status = handle.client.call("submit", payload,
                                         timeout=lease_timeout)
+        except BackpressureError as e:
+            # typed shed (RESOURCE_EXHAUSTED frame): honor the backoff
+            self._defer_shed(handle, spec, hint_s=e.backoff_s)
+            return
         except Exception:
             self._requeue_remote(handle, spec)
             self._wake.set()
@@ -540,6 +728,7 @@ class NodeManagerGroup:
             self._wake.set()
             return
         self._record_shipped_functions(handle, [payload])
+        spec._shed_backoff_s = 0.0  # type: ignore[attr-defined]
         from ray_tpu._private import events
         events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
                       worker=f"node:{handle.node_id.hex()[:8]}")
@@ -611,6 +800,11 @@ class NodeManagerGroup:
             "streaming": spec.streaming,
             "stream_skip": spec.stream_skip,
             "resources": dict(spec.resources),
+            # The memory watchdog prefers retryable victims; a task the
+            # owner would not retry should only die under pressure when
+            # nothing retryable is running (reference: memory-monitor
+            # victim selection by retriability).
+            "retryable": spec.max_retries > 0,
         }
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             payload["actor_id"] = spec.actor_creation_id.binary()
@@ -683,7 +877,14 @@ class NodeManagerGroup:
                 return
         sys_err = None
         if msg.get("system_error"):
-            sys_err = WorkerCrashedError(msg["system_error"])
+            if msg.get("oom"):
+                # memory-watchdog kill: typed, with the task's own
+                # retriability — routed through the OOM retry budget
+                sys_err = OutOfMemoryError(
+                    msg["system_error"],
+                    retryable=bool(msg.get("oom_retryable", True)))
+            else:
+                sys_err = WorkerCrashedError(msg["system_error"])
         results = []
         for oid_b, kind, data, contained in msg.get("results", ()):
             if kind == "remote":
@@ -1122,6 +1323,15 @@ class NodeManagerGroup:
             if spec is None:
                 spec = self._infeasible.pop(task_id, None)
             if spec is None:
+                # parked in the overload plane's deferred queue (shed
+                # backoff / OOM retry): it holds no allocation, so
+                # removal is the whole cancellation
+                for item in list(self._deferred):
+                    if item[1].task_id == task_id:
+                        self._deferred.remove(item)
+                        spec = item[1]
+                        break
+            if spec is None:
                 for node_id, raylet in self._raylets.items():
                     for q_spec in list(raylet.dispatch_queue):
                         if q_spec.task_id == task_id:
@@ -1239,6 +1449,8 @@ class NodeManagerGroup:
                             self._infeasible.clear()
                 if self.pg_manager is not None:
                     self.pg_manager.try_schedule_pending()
+                # shed/OOM'd specs whose backoff expired rejoin here
+                self._pump_deferred()
                 # Cap the batch at roughly what can place right now:
                 # at queue depth, re-scanning the ENTIRE backlog on
                 # every capacity change made each tick O(backlog) in
@@ -2046,7 +2258,20 @@ class NodeManagerGroup:
                 "running": len(self._running),
                 "infeasible": len(self._infeasible),
                 "actors": len(self._actor_workers),
+                "deferred": len(self._deferred),
+                "shed": self.num_shed,
+                "window_waits": self.num_window_waits,
             }
+
+    def inflight_windows(self) -> Dict[str, int]:
+        """node-hex -> current in-flight lease count per remote node
+        (the inflight_window gauge's data source); one scan covers
+        every node."""
+        with self._lock:
+            nodes = [nid for nid, h in self._remote_nodes.items()
+                     if h.alive]
+        counts = self._remote_inflight_counts()
+        return {nid.hex()[:12]: counts.get(nid, 0) for nid in nodes}
 
 
 class _DependencyError(Exception):

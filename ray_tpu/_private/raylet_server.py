@@ -84,6 +84,8 @@ class RayletServer:
             max_process_workers=max_process_workers)
 
         self._lock = threading.RLock()
+        # unbounded-ok: bounded by admission control — _admit_payload
+        # sheds submits once len() reaches raylet_max_queued_tasks
         self._dispatch_queue: deque = deque()
         self._running: Dict[bytes, BaseWorker] = {}   # task_id -> worker
         self._actor_workers: Dict[bytes, BaseWorker] = {}
@@ -123,6 +125,17 @@ class RayletServer:
         self._wake = threading.Event()
         self._shutdown = threading.Event()
         self.num_pulled = 0   # objects fetched from peers (transfer stat)
+        # Overload plane (see docs/fault_tolerance.md "Overload
+        # semantics"): bounded scheduler intake + node memory watchdog.
+        self._max_queued = cfg.raylet_max_queued_tasks
+        self.num_shed = 0          # submits shed at admission
+        self.num_oom_kills = 0     # tasks killed by the memory watchdog
+        # task_id -> {"retryable": bool, "name": str} for running tasks
+        # (the watchdog's victim-selection input)
+        self._running_meta: Dict[bytes, dict] = {}  # guarded-by: _lock
+        # task_ids the watchdog killed: their worker-death completion
+        # ships an OutOfMemoryError marker instead of a generic crash
+        self._oom_victims: Dict[bytes, bool] = {}  # guarded-by: _lock
         from ray_tpu._private.pip_env import PipEnvManager
         self._pip_envs = PipEnvManager(self._on_pip_env_requeue)
 
@@ -152,6 +165,11 @@ class RayletServer:
             target=self._io_loop, daemon=True, name="rtpu-raylet-io")
         self._dispatch_thread.start()
         self._io_thread.start()
+        if cfg.memory_watchdog_threshold > 0:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name="rtpu-raylet-watchdog")
+            self._watchdog_thread.start()
 
         self.gcs: Optional[GcsClient] = None
         if gcs_addr is not None:
@@ -372,22 +390,50 @@ class RayletServer:
     # -- lease / submit path -------------------------------------------
 
     def _handle_submit(self, ctx: ConnectionContext, payload: dict) -> str:
-        """Admit a task payload. Returns "ok" or "refused" (spillback:
-        the demand can never fit this node)."""
+        """Admit a task payload. Returns "ok", or "refused" (spillback:
+        the demand can never fit this node); a full intake queue sheds
+        the submit with a typed BackpressureError instead (the RPC
+        layer ships it as a RESOURCE_EXHAUSTED frame)."""
         status = self._admit_payload(ctx, payload)
+        if status == "shed":
+            raise self._backpressure_error()
         if status == "ok":
             self._wake.set()
         return status
 
     def _handle_submit_many(self, ctx: ConnectionContext,
-                            payloads: list) -> List[str]:
+                            payloads: list) -> list:
         """Admit N task payloads in ONE lease round trip (the owner
         coalesces per-raylet); per-payload statuses keep spillback
-        refusals per-task."""
+        refusals — and backpressure sheds — per-task. Sheds travel as
+        ("shed", backoff_s) so the depth-scaled backoff suggestion
+        reaches the owner on the batched path too, not just the
+        single-submit error frame."""
         statuses = [self._admit_payload(ctx, p) for p in payloads]
         if any(s == "ok" for s in statuses):
             self._wake.set()
+        if any(s == "shed" for s in statuses):
+            hint = self._backpressure_error().backoff_s
+            statuses = [("shed", hint) if s == "shed" else s
+                        for s in statuses]
         return statuses
+
+    def _backpressure_error(self) -> "BackpressureError":
+        from ray_tpu.exceptions import BackpressureError
+        with self._lock:
+            depth = len(self._dispatch_queue)
+        base = get_config().backpressure_retry_base_ms / 1000.0
+        return BackpressureError(
+            f"raylet {self.node_id.hex()[:8]} intake full "
+            f"({depth} queued >= {self._max_queued}); retry later",
+            retryable=True,
+            # Suggested backoff: 2x the base at a full queue (growing
+            # toward 4x if the queue ever runs past the bound), so the
+            # suggestion genuinely EXCEEDS the owner's own first-shed
+            # schedule (which starts at base) and the wins-when-larger
+            # branch is reachable.
+            backoff_s=base * min(4.0, 2.0 * depth
+                                 / max(1, self._max_queued)))
 
     def _admit_payload(self, ctx: ConnectionContext, payload: dict) -> str:
         # Cache the function blob BEFORE the admission check: within a
@@ -402,6 +448,14 @@ class RayletServer:
             if need > self.resources_total.get(name, 0.0) + 1e-9:
                 return "refused"
         with self._lock:
+            # Bounded intake (reference: backpressured task submission):
+            # beyond the bound, shed instead of queuing forever. Shed
+            # BEFORE any routing state is recorded — the owner re-sends
+            # the payload whole after its backoff.
+            if (self._max_queued > 0
+                    and len(self._dispatch_queue) >= self._max_queued):
+                self.num_shed += 1
+                return "shed"
             self._task_ctx[payload["task_id"]] = ctx
             if payload["type"] == "create_actor":
                 aid = payload["actor_id"]
@@ -606,6 +660,9 @@ class RayletServer:
                 worker, fid, lambda: self._functions[fid])
             with self._lock:
                 self._running[payload["task_id"]] = worker
+                self._running_meta[payload["task_id"]] = {
+                    "retryable": bool(payload.get("retryable", True)),
+                    "name": payload.get("name", "?")}
                 if payload["type"] != "exec_actor":
                     # actor METHOD calls ride the actor's standing
                     # allocation; exec/create_actor consume capacity
@@ -618,6 +675,7 @@ class RayletServer:
         except Exception as e:
             with self._lock:
                 self._running.pop(payload["task_id"], None)
+                self._running_meta.pop(payload["task_id"], None)
             if not actor:
                 self.worker_pool.push_worker(worker)
             self._push_owner("task_done", {
@@ -725,7 +783,9 @@ class RayletServer:
             timings = reply[4] if len(reply) > 4 else None
             with self._lock:
                 self._running.pop(task_id, None)
+                self._running_meta.pop(task_id, None)
                 self._running_demand.pop(task_id, None)
+                self._oom_victims.pop(task_id, None)  # finished first
             if not worker.is_actor_worker:
                 self.worker_pool.push_worker(worker)
             # Seal big results into the node store; ship locations.
@@ -753,6 +813,7 @@ class RayletServer:
                 demand = {}
                 if tid is not None:
                     self._running.pop(tid, None)
+                    self._running_meta.pop(tid, None)
                     # the creation demand becomes the actor's standing
                     # allocation for its lifetime
                     demand = self._running_demand.pop(tid, {})
@@ -782,18 +843,33 @@ class RayletServer:
         worker.kill()
         dead_tasks: List[bytes] = []
         dead_actors: List[bytes] = []
+        oom: Dict[bytes, bool] = {}
         with self._lock:
             for tid, w in list(self._running.items()):
                 if w is worker:
                     dead_tasks.append(tid)
                     self._running.pop(tid)
+                    self._running_meta.pop(tid, None)
                     self._running_demand.pop(tid, None)
+                    if tid in self._oom_victims:
+                        oom[tid] = self._oom_victims.pop(tid)
             for aid, w in list(self._actor_workers.items()):
                 if w is worker:
                     dead_actors.append(aid)
                     self._actor_workers.pop(aid)
                     self._actor_demand.pop(aid, None)
         for tid in dead_tasks:
+            if tid in oom:
+                # Killed by the memory watchdog: ship the typed marker
+                # so the owner routes it through the OOM retry budget
+                # (or surfaces OutOfMemoryError for non-retryable work).
+                self._push_owner("task_done", {
+                    "task_id": tid, "results": [], "error_blob": None,
+                    "system_error": "task killed by the node memory "
+                                    "watchdog (memory pressure)",
+                    "oom": True, "oom_retryable": oom[tid]},
+                    ctx=self._ctx_for_task(tid, pop=True))
+                continue
             self._push_owner("task_done", {
                 "task_id": tid, "results": [], "error_blob": None,
                 "system_error": "worker process died while executing task"},
@@ -856,12 +932,183 @@ class RayletServer:
                 "running_tasks": len(self._running),
                 "actors": len(self._actor_workers),
                 "objects_pulled": self.num_pulled,
+                "shed_tasks": self.num_shed,
+                "oom_kills": self.num_oom_kills,
                 "store_used_bytes": store["used_bytes"],
                 "store_num_objects": store["num_objects"],
                 "workers": self.worker_pool.stats()["total"],
                 "workers_rss_bytes": sum(rss.values()),
                 "worker_rss": rss,
             }
+
+    # -- memory watchdog -----------------------------------------------
+
+    @staticmethod
+    def _meminfo_bytes() -> Tuple[int, int]:
+        """(MemTotal, MemAvailable) from /proc/meminfo; (0, 0) when
+        unreadable (non-linux)."""
+        total = avail = 0
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1]) * 1024
+                    elif line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1]) * 1024
+        except (OSError, ValueError, IndexError):
+            return 0, 0
+        return total, avail
+
+    def _memory_usage_fraction(self) -> float:
+        """Observed node memory pressure.
+
+        Host mode (``memory_watchdog_total_bytes`` unset): system
+        truth — ``(MemTotal - MemAvailable) / MemTotal`` counts every
+        consumer (process RSS, tmpfs-backed shm segments) exactly once,
+        like the reference memory monitor.
+
+        Explicit-total mode (containers, tests): this raylet's own
+        footprint — process-tree RSS plus object-store bytes. Shm
+        pages a live process has mapped appear in both terms, so this
+        is an UPPER bound: the watchdog errs toward shedding a
+        retryable task early rather than letting the node OOM.
+        """
+        from ray_tpu._private.profiling import (process_rss_bytes,
+                                                worker_rss_map)
+        cfg = get_config()
+        configured = cfg.memory_watchdog_total_bytes
+        own = (process_rss_bytes()
+               + sum(worker_rss_map(self.worker_pool).values())
+               + self.shm_store.stats()["used_bytes"])
+        if not configured:
+            total, avail = self._meminfo_bytes()
+            if total <= 0:
+                return 0.0
+            frac = (total - avail) / total
+            if frac >= cfg.memory_watchdog_threshold \
+                    and own < (1.0 - cfg.memory_watchdog_threshold) \
+                    * total:
+                # The host is under pressure but OUR footprint doesn't
+                # even cover the threshold's slack: killing our tasks
+                # cannot relieve it (external consumer) — serially
+                # executing innocents would burn their OOM budgets for
+                # nothing. Report healthy; the external hog is the
+                # operator's problem.
+                return 0.0
+            return frac
+        return own / configured
+
+    def _watchdog_loop(self) -> None:
+        """Reference analog: the raylet memory monitor — sample node
+        memory each heartbeat; above the threshold, kill the largest
+        retryable running task so the node survives and the task
+        retries (a saturated node costs latency, never results)."""
+        period = get_config().health_check_period_ms / 1000.0
+        while not self._shutdown.wait(period):
+            try:
+                self._watchdog_tick()
+            except Exception:
+                logger.exception("memory watchdog tick failed")
+
+    def _watchdog_tick(self) -> None:
+        from ray_tpu._private import chaos
+        from ray_tpu._private.profiling import process_rss_bytes
+        candidates = self._watchdog_candidates()
+        if not candidates:
+            # Nothing killable running: skip the sample (and the chaos
+            # point — rules like `pressure=0.97@1` then deterministically
+            # fire on the first sample at which a kill could matter).
+            return
+        frac = None
+        if chaos._plane.armed:
+            # The event method carries the candidate count
+            # (`sampleN`): tests match `sample*` for any sample, or
+            # `sample2` to inject pressure deterministically at the
+            # first sample where exactly two victims are running.
+            action, arg = chaos.fire_arg(
+                "raylet", "watchdog", f"sample{len(candidates)}")
+            if action == "pressure":
+                frac = arg
+        if frac is None:
+            frac = self._memory_usage_fraction()
+        if frac < get_config().memory_watchdog_threshold:
+            return
+        # Victim selection: retryable tasks strictly before
+        # non-retryable ones; within a class, the largest worker RSS.
+        # One victim per sample — the next sample re-measures before
+        # deciding whether the node is still under pressure. RSS read
+        # once per pid (it is also what the kill log reports — a read
+        # after the SIGKILL would always say 0).
+        rss = {c[3]: process_rss_bytes(c[3]) for c in candidates}
+        candidates.sort(key=lambda c: (not c[0], -rss[c[3]]))
+        retryable, tid, worker, pid = candidates[0]
+        with self._lock:
+            # Re-verify under the lock: the victim may have COMPLETED
+            # during the RSS reads above, and its worker re-leased to
+            # a fresh task — killing that would burn an innocent
+            # task's crash budget (and leave a stale victim mark for a
+            # reused task id). Skip; the next sample re-measures. The
+            # same applies to a worker that CRASHED during selection —
+            # its death handler must report a plain crash, not an OOM.
+            if self._running.get(tid) is not worker \
+                    or worker.proc.poll() is not None:
+                return
+            name = self._running_meta.get(tid, {}).get("name", "?")
+            self._oom_victims[tid] = retryable
+            self.num_oom_kills += 1
+            # The kill itself stays under the lock: the done-handler
+            # pops _running under this same lock, so check->mark->kill
+            # is atomic against a completion racing in — once killed,
+            # a late reply can no longer re-lease this worker to an
+            # innocent task before the process dies.
+            #
+            # chaos-style exit path: the worker dies abruptly and the
+            # normal worker-death machinery completes the task (with
+            # the OOM marker recorded above). Killing the whole
+            # process kills ONLY the victim: this raylet leases one
+            # task per process worker at a time (no lease pipelining
+            # on the remote path), and actor workers are never
+            # candidates.
+            worker.kill()
+            try:
+                # SIGKILL on top of the pool teardown's terminate():
+                # an OOM victim must not be able to trap or defer its
+                # death (a surviving hog would push the watchdog into
+                # serially killing every innocent task instead).
+                worker.proc.kill()
+            except Exception:
+                pass    # already exited
+        logger.warning(
+            "memory watchdog: node at %.2f usage (threshold %.2f); "
+            "killed %s task %s (%s, rss=%d)",
+            frac, get_config().memory_watchdog_threshold,
+            "retryable" if retryable else "non-retryable",
+            tid.hex()[:8], name, rss[pid])
+
+    def _watchdog_candidates(self):
+        """[(retryable, task_id, worker, pid)] for running tasks the
+        watchdog may kill: process workers only (in-process threads
+        cannot be killed), never resident actors (their state is not
+        re-creatable by a retry), never an already-marked victim."""
+        out = []
+        with self._lock:
+            for tid, worker in self._running.items():
+                if tid in self._oom_victims or not worker.alive \
+                        or worker.is_actor_worker:
+                    continue
+                proc = getattr(worker, "proc", None)
+                pid = getattr(proc, "pid", None)
+                if pid is None:
+                    continue
+                if proc.poll() is not None:
+                    # Already dead of natural causes: the death
+                    # handler owns it — marking it here would charge a
+                    # plain crash to the OOM budget.
+                    continue
+                meta = self._running_meta.get(tid, {})
+                out.append((bool(meta.get("retryable", True)), tid,
+                            worker, pid))
+        return out
 
     # -- lifecycle -----------------------------------------------------
 
@@ -889,6 +1136,8 @@ class RayletServer:
                 "running": len(self._running),
                 "actors": len(self._actor_workers),
                 "num_pulled": self.num_pulled,
+                "num_shed": self.num_shed,
+                "num_oom_kills": self.num_oom_kills,
                 "available": self.available_resources(),
                 "store": self.shm_store.stats(),
                 "workers": self.worker_pool.stats(),

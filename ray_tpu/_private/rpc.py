@@ -27,6 +27,14 @@ ORIGINAL outcome replayed instead of a second execution — submits and
 puts stay exactly-once across retries. Frames without a token (legacy
 peers, oneways) behave exactly as before.
 
+The reply's ``ok`` field is normally True/False; the sentinel
+``RESOURCE_EXHAUSTED`` marks an overload shed (the handler raised a
+``SystemOverloadError`` subclass — see ``ray_tpu/exceptions.py``).
+Clients re-raise the TYPED exception (retryable flag + suggested
+backoff intact) instead of wrapping it in RpcError, and the retrying
+client does NOT burn its deadline on it: overload is the caller's
+backpressure signal, not a transport fault.
+
 Fault tolerance layers here (see docs/fault_tolerance.md):
 ``RetryingRpcClient`` wraps ``RpcClient`` with transparent reconnect
 (exponential backoff + jitter), per-call deadlines, and per-call
@@ -59,6 +67,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from ray_tpu._private import chaos
+from ray_tpu.exceptions import SystemOverloadError
 
 logger = logging.getLogger(__name__)
 
@@ -255,6 +264,11 @@ def _recv_frame(sock: socket.socket, component: str = ""):
 
 class RpcError(Exception):
     """Remote handler raised; carries the remote exception."""
+
+
+# Reply-frame ok-field sentinel: the handler shed this call with a
+# typed overload error (BackpressureError / OutOfMemoryError / ...).
+RESOURCE_EXHAUSTED = "RESOURCE_EXHAUSTED"
 
 
 class ConnectionLost(ConnectionError):
@@ -491,6 +505,10 @@ class RpcServer:
                 else:
                     try:
                         ok, payload = True, fn(ctx, *args)
+                    except SystemOverloadError as e:
+                        # First-class shed: the typed error (retryable
+                        # flag + suggested backoff) rides the frame.
+                        ok, payload = RESOURCE_EXHAUSTED, e
                     except Exception as e:  # noqa: BLE001 - ships to caller
                         logger.debug("handler %s raised", method,
                                      exc_info=True)
@@ -564,8 +582,14 @@ class RpcClient:
         except (ConnectionError, OSError, EOFError) as e:
             self._sock.close()
             if isinstance(e, ProtocolError):
-                raise
-            raise ProtocolError(
+                raise       # bad magic / version: genuinely unretryable
+            # A reset/EOF mid-handshake is a TRANSIENT fault (e.g. a
+            # reconnect racing a server restart on the same port), not
+            # a refusal: surface ConnectionError so retrying clients
+            # back off and try again instead of giving the peer up for
+            # good. ProtocolError is reserved for explicit refusals
+            # (hello_err) and version/magic mismatches.
+            raise ConnectionError(
                 f"server at {self.address} closed during handshake "
                 f"({e})") from e
         if hello[0] != "hello_ok":
@@ -584,6 +608,9 @@ class RpcClient:
         # handler is allowed to issue blocking call()s on this same
         # client, and those replies can only be read by the reader —
         # running handlers there would self-deadlock.
+        # unbounded-ok: drained by a dedicated push thread; producers
+        # are server pushes already bounded by the peer's buffers, and
+        # blocking the reader here would stall reply delivery
         self._push_queue: queue.Queue = queue.Queue()
         if on_push is not None:
             self._push_thread = threading.Thread(
@@ -675,6 +702,13 @@ class RpcClient:
                 f"rpc call {method!r} timed out after {timeout}s") from None
         if ok is None:
             raise payload           # reader-injected: connection died
+        if ok == RESOURCE_EXHAUSTED:
+            # Typed overload shed: surface it as-is so the caller's
+            # backpressure logic sees retryable/backoff_s. (Checked
+            # before the truthiness test — the sentinel is a string.)
+            if isinstance(payload, SystemOverloadError):
+                raise payload
+            raise RpcError(str(payload))
         if ok:
             return payload
         if isinstance(payload, BaseException):

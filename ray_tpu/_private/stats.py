@@ -35,6 +35,12 @@ def install_runtime_metrics() -> None:
                     tag_keys=("state",))
     actors = m.Gauge("ray_tpu_actors", "Actors by state",
                      tag_keys=("state",))
+    oom_kills = m.Gauge(
+        "ray_tpu_oom_kills",
+        "Tasks killed by the node memory watchdog (owner view)")
+    inflight = m.Gauge(
+        "ray_tpu_inflight_window",
+        "Owner->raylet in-flight lease window usage", tag_keys=("node",))
 
     def collect():
         from ray_tpu._private.worker import try_global_worker
@@ -44,14 +50,24 @@ def install_runtime_metrics() -> None:
         tm = w.task_manager.stats()
         for state in ("pending", "finished", "failed", "retries"):
             tasks.set(tm.get(state, 0), tags={"state": state})
+        ng_stats = w.node_group.stats()
+        # overload plane: cumulative sheds honored, plus the live
+        # count of backpressured (deferred) tasks — the latter returns
+        # to zero once the overload clears
+        tasks.set(ng_stats.get("shed", 0), tags={"state": "shed"})
+        tasks.set(ng_stats.get("deferred", 0),
+                  tags={"state": "backpressured"})
+        oom_kills.set(tm.get("oom_kills", 0))
+        inflight.clear()
+        for node_hex, count in w.node_group.inflight_windows().items():
+            inflight.set(count, tags={"node": node_hex})
         store = w.shm_store.stats()
         objects.set(store["used_bytes"], tags={"kind": "used"})
         objects.set(store["capacity_bytes"], tags={"kind": "capacity"})
         hbm.set(w.device_store.stats()["hbm_bytes"])
-        ng = w.node_group.stats()
         for queue in ("to_schedule", "waiting_deps", "running",
-                      "infeasible"):
-            sched.set(ng.get(queue, 0), tags={"queue": queue})
+                      "infeasible", "deferred"):
+            sched.set(ng_stats.get(queue, 0), tags={"queue": queue})
         infos = w.gcs.get_all_node_info()
         nodes.set(sum(1 for i in infos if i.alive), tags={"state": "alive"})
         nodes.set(sum(1 for i in infos if not i.alive),

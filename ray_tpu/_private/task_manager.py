@@ -16,7 +16,11 @@ from typing import Callable, Dict, List, Optional
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.task_spec import TaskSpec
-from ray_tpu.exceptions import TaskError, WorkerCrashedError
+from ray_tpu.exceptions import (
+    OutOfMemoryError,
+    TaskError,
+    WorkerCrashedError,
+)
 
 
 @dataclass
@@ -38,6 +42,11 @@ class TaskRecord:
     # (no retry) and surfaces as TaskCancelledError; a result that
     # lands anyway wins (best-effort semantics, like the reference).
     cancelled: bool = False
+    # Memory-watchdog kills spend THIS budget (``task_oom_retries``),
+    # not the failure-retry one: a task repeatedly evicted under
+    # pressure must not burn the retries that guard real crashes
+    # (reference: the memory monitor's separate OOM retry counter).
+    oom_retries_left: int = 0
 
 
 def _contained_item(c):
@@ -94,14 +103,24 @@ class TaskManager:
         self.num_failed = 0
         self.num_retries = 0
         self.num_reconstructions = 0
+        self.num_oom_kills = 0      # watchdog kills observed
+        self.num_oom_retries = 0    # of those, transparently retried
+        self.num_unfinished = 0     # live (pending|running) records
+        from ray_tpu._private.backoff import make_rng
+        self._backoff_rng = make_rng()   # OOM-retry jitter
 
     # -- submission --------------------------------------------------------
 
     def add_pending_task(self, spec: TaskSpec) -> None:
+        from ray_tpu._private.config import get_config
         with self._lock:
+            prev = self._tasks.get(spec.task_id)
+            if prev is None or prev.status in ("finished", "failed"):
+                self.num_unfinished += 1
             self._tasks[spec.task_id] = TaskRecord(
                 spec=spec, retries_left=spec.max_retries,
-                reconstructions_left=spec.max_retries)
+                reconstructions_left=spec.max_retries,
+                oom_retries_left=get_config().task_oom_retries)
             # an oid embeds its producing task id, so re-adding the same
             # spec (actor restart) simply restores its full entry set
             for oid in spec.return_ids:
@@ -158,9 +177,11 @@ class TaskManager:
             if rec is None:
                 return
             if error_blob is None and system_error is None:
-                rec.status = "finished"
+                self._mark_terminal(rec, "finished")
                 self.num_finished += 1
                 self._release_args(rec)
+                # a lineage re-run of this spec starts OOM backoff fresh
+                rec.spec._oom_backoff_s = 0.0  # type: ignore[attr-defined]
                 kind_map = {"inline": "blob", "shm": "shm",
                             "remote": "remote"}
                 for oid_b, kind, data, contained in results:
@@ -173,13 +194,50 @@ class TaskManager:
             if rec.cancelled:
                 # cancelled: terminal, no retry, canonical error
                 from ray_tpu.exceptions import TaskCancelledError
-                rec.status = "failed"
+                self._mark_terminal(rec, "failed")
                 self.num_failed += 1
                 self._release_args(rec)
                 blob = serialization.get_context().serialize(
                     TaskCancelledError(
                         f"task {rec.spec.repr_name()} was cancelled"
                     )).to_bytes()
+                for oid in rec.spec.return_ids:
+                    self._store_result(oid, Entry("err", blob))
+                return
+            if isinstance(system_error, OutOfMemoryError):
+                # Memory-watchdog kill: its own retry budget
+                # (task_oom_retries) with exponential backoff; a
+                # non-retryable victim surfaces the typed error.
+                self.num_oom_kills += 1
+                if system_error.retryable and rec.oom_retries_left > 0:
+                    from ray_tpu._private.backoff import (jittered,
+                                                          next_backoff)
+                    from ray_tpu._private.config import get_config
+                    cfg = get_config()
+                    rec.oom_retries_left -= 1
+                    rec.attempt += 1
+                    rec.status = "pending"
+                    self.num_retries += 1
+                    self.num_oom_retries += 1
+                    # shared shed-retry schedule: doubling, capped,
+                    # jittered (a raylet under real memory pressure
+                    # evicts MANY tasks at once — they must not all
+                    # come back in the same tick)
+                    nxt = next_backoff(
+                        getattr(rec.spec, "_oom_backoff_s", 0.0),
+                        cfg.backpressure_retry_base_ms / 1000.0,
+                        cfg.backpressure_retry_max_ms / 1000.0,
+                        hint_s=system_error.backoff_s)
+                    rec.spec._oom_backoff_s = nxt  # type: ignore[attr-defined]
+                    rec.spec._resubmit_delay_s = jittered(  # type: ignore[attr-defined]
+                        nxt, self._backoff_rng)
+                    self._resubmit(rec.spec)
+                    return
+                self._mark_terminal(rec, "failed")
+                self.num_failed += 1
+                self._release_args(rec)
+                blob = serialization.get_context().serialize(
+                    system_error).to_bytes()
                 for oid in rec.spec.return_ids:
                     self._store_result(oid, Entry("err", blob))
                 return
@@ -194,7 +252,7 @@ class TaskManager:
                 self.num_retries += 1
                 self._resubmit(rec.spec)
                 return
-            rec.status = "failed"
+            self._mark_terminal(rec, "failed")
             self.num_failed += 1
             self._release_args(rec)
             if error_blob is None:
@@ -208,6 +266,33 @@ class TaskManager:
                 error_blob = serialization.get_context().serialize(err).to_bytes()
             for oid in rec.spec.return_ids:
                 self._store_result(oid, Entry("err", error_blob))
+
+    def mark_failed_external(self, task_id: TaskID) -> None:
+        """Record an OUT-OF-BAND terminal failure — the caller stored
+        the error entries itself (Worker._fail_task's actor-death /
+        lost-object paths, which must bypass retry handling). Without
+        this transition the record stays 'pending' forever and
+        ``num_unfinished`` — the nested-intake backpressure signal —
+        ratchets up by one per such failure until the owner sheds
+        everything."""
+        with self._lock:
+            rec = self._tasks.get(task_id)
+            if rec is None or rec.status in ("finished", "failed"):
+                return
+            self._mark_terminal(rec, "failed")
+            self.num_failed += 1
+            self._release_args(rec)
+
+    # lock-held: _lock
+    def _mark_terminal(self, rec: TaskRecord, status: str) -> None:
+        """Status transition that keeps ``num_unfinished`` (the
+        owner's nested-intake backpressure signal) exact: a record
+        already terminal (late duplicate completion) doesn't double-
+        decrement."""
+        if rec.status not in ("finished", "failed") \
+                and self.num_unfinished > 0:
+            self.num_unfinished -= 1
+        rec.status = status
 
     @staticmethod
     def _error_matches(error_blob: bytes, retry_exceptions) -> bool:
@@ -266,6 +351,8 @@ class TaskManager:
                 return None, False
             rec.reconstructions_left -= 1
             rec.attempt += 1
+            if rec.status in ("finished", "failed"):
+                self.num_unfinished += 1   # terminal -> live again
             rec.status = "pending"
             self.num_reconstructions += 1
             return rec.spec, True
@@ -300,4 +387,6 @@ class TaskManager:
                 "finished": self.num_finished,
                 "failed": self.num_failed,
                 "retries": self.num_retries,
+                "oom_kills": self.num_oom_kills,
+                "oom_retries": self.num_oom_retries,
             }

@@ -238,6 +238,8 @@ class Worker:
         self.node_stats: Dict[NodeID, Tuple[float, dict]] = {}
         # streaming tasks: highest item index delivered (retry resume)
         self._stream_progress: Dict[TaskID, int] = {}
+        # nested submissions shed at the owner's bounded intake
+        self.num_nested_shed = 0
         # object-ready callbacks (serve router in-flight accounting and
         # any other completion hook) — fired inline on the completion
         # path, so no per-ref waiter threads
@@ -768,11 +770,41 @@ class Worker:
     def _nested_named_actor(self, ctx, name: str, namespace: str):
         return self.gcs.get_named_actor(name, namespace)
 
+    def _check_nested_intake(self) -> None:
+        """Bounded nested-submission intake (owner_max_pending_tasks):
+        a worker fanning out children without bound is shed with a
+        typed BackpressureError — the in-worker client retries with
+        backoff, so a saturated owner costs latency, never results.
+
+        The bound applies to the QUEUED backlog (unfinished minus
+        currently-executing): counting executing tasks would wedge —
+        N blocked parents at the bound could never submit the children
+        they are waiting on, and the count would never drain."""
+        bound = get_config().owner_max_pending_tasks
+        if bound <= 0:
+            return
+        with self.node_group._lock:
+            executing = len(self.node_group._running)
+        pending = max(0, self.task_manager.num_unfinished - executing)
+        if pending >= bound:
+            from ray_tpu.exceptions import BackpressureError
+            self.num_nested_shed += 1
+            base = get_config().backpressure_retry_base_ms / 1000.0
+            raise BackpressureError(
+                f"owner intake full ({pending} unfinished tasks >= "
+                f"{bound}); retry later", retryable=True,
+                backoff_s=base)
+
     def _nested_submit(self, ctx, fid: bytes, fn_blob, fn_name: str,
                        arg_descs, kwargs_keys, options_dict) -> List[bytes]:
+        # Cache the function blob BEFORE the intake check (mirrors the
+        # raylet's _admit_payload): the nested client ships a blob only
+        # once, so shedding the carrying submit past its deadline must
+        # not strand every later call of this function blob-less.
         if fn_blob is not None:
             with self._functions_lock:
                 self._functions.setdefault(fid, fn_blob)
+        self._check_nested_intake()
         descriptor = FunctionDescriptor(function_id=fid, module="",
                                         name=fn_name)
         spec_args: List[TaskArg] = []
@@ -1162,8 +1194,17 @@ class Worker:
             # already-delivered items): resume past the highest item the
             # owner RECEIVED (tracked at delivery — scanning the store
             # would under-count, since consumed items may already have
-            # been freed on ref-drop).
+            # been freed on ref-drop). BEFORE the deferred-retry branch:
+            # an OOM-retried generator must resume, not replay.
             spec.stream_skip = self._stream_progress.get(spec.task_id, 0)
+        # OOM retries carry an exponential-backoff delay (set by the
+        # task manager): park the spec instead of hammering a node
+        # that just shed it for memory pressure.
+        delay = getattr(spec, "_resubmit_delay_s", 0.0)
+        if delay > 0 and spec.task_type == TaskType.NORMAL_TASK:
+            spec._resubmit_delay_s = 0.0  # type: ignore[attr-defined]
+            self.node_group.submit_task_after(spec, delay)
+            return
         if spec.task_type == TaskType.ACTOR_TASK:
             with self._actor_lock:
                 queue = self._actor_queues.get(spec.actor_id)
@@ -1188,6 +1229,9 @@ class Worker:
             return
         for oid in spec.return_ids:
             self._store_result(oid, Entry("err", blob))
+        # Out-of-band terminal failure: transition the record too, or
+        # num_unfinished (the nested-intake signal) leaks one forever.
+        self.task_manager.mark_failed_external(spec.task_id)
 
     def _complete_task(self, task_id: TaskID, results, err_blob,
                        system_error, timings: Optional[dict] = None
@@ -1338,6 +1382,9 @@ class Worker:
                               "state": "REGISTERED",
                               "class_name": class_name})
         with self._actor_lock:
+            # unbounded-ok: per-actor ordered call queue, drained by
+            # the flusher thread in _ACTOR_FLUSH_BATCH frames; calls
+            # enter one public submit_actor_task at a time
             self._actor_queues[actor_id] = deque()
             self._actor_seq[actor_id] = 0
             self._actor_specs[actor_id] = spec
@@ -1387,6 +1434,8 @@ class Worker:
                 f"actor {info.class_name} is hosted on node "
                 f"{node_id.hex()[:8]}, which is not reachable")
         with self._actor_lock:
+            # unbounded-ok: same per-actor flusher-drained queue as
+            # create_actor's (see there)
             self._actor_queues.setdefault(actor_id, deque())
             self._actor_seq.setdefault(actor_id, 0)
             # Another driver owns restarts; we never restart it.

@@ -44,6 +44,8 @@ class BaseWorker:
         # is their send order (head = executing); ``last_activity``
         # and ``steal_pending`` drive the stalled-pipeline rescue.
         self.inflight = 0
+        # unbounded-ok: dispatch never queues past PIPELINE_DEPTH
+        # (pipeline_candidate refuses workers at the cap)
         self.pipeq: "deque" = deque()
         self.last_activity = time.monotonic()
         self.steal_pending = False
@@ -153,6 +155,9 @@ class InProcessWorker(BaseWorker):
                  reply_handler: Callable[["InProcessWorker", tuple], None]):
         super().__init__()
         self.env = ExecutionEnv(session, max_inline_bytes)
+        # unbounded-ok: fed by the dispatcher one leased task at a
+        # time (plus control messages); a bound here could deadlock
+        # the shutdown path
         self._queue: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._reply = reply_handler
         self.ready = True

@@ -8,6 +8,7 @@ from typing import List
 def load_passes() -> List:
     from ray_tpu.devtools.analysis.passes import (
         async_blocking,
+        bounded_queue,
         lock_discipline,
         ref_leak,
         retry_discipline,
@@ -15,4 +16,5 @@ def load_passes() -> List:
         silent_exception,
     )
     return [lock_discipline, async_blocking, rpc_surface,
-            silent_exception, ref_leak, retry_discipline]
+            silent_exception, ref_leak, retry_discipline,
+            bounded_queue]

@@ -120,3 +120,44 @@ class ObjectStoreFullError(RayTpuError):
 class PlacementGroupError(RayTpuError):
     """A task/actor bound to a placement group cannot run there
     (group removed, or demand can never fit the bundle)."""
+
+
+class SystemOverloadError(RayTpuError):
+    """Base of the overload-plane taxonomy (reference: the memory
+    monitor's retryable ``OutOfMemoryError`` and backpressured task
+    submission). Carries:
+
+    - ``retryable``: the failed work is safe to re-run (nothing
+      executed, or the execution was killed before side effects were
+      owed) — the owner retries it transparently;
+    - ``backoff_s``: the raiser's suggested retry delay (0 = use the
+      caller's own schedule).
+
+    The RPC layer ships these as a first-class ``RESOURCE_EXHAUSTED``
+    reply frame, so callers receive the TYPED error (flags intact)
+    rather than a generic ``RpcError`` wrap.
+    """
+
+    def __init__(self, msg: str = "system overload",
+                 retryable: bool = True, backoff_s: float = 0.0):
+        super().__init__(msg)
+        self.retryable = bool(retryable)
+        self.backoff_s = float(backoff_s)
+
+    def __reduce__(self):
+        return (type(self), (self.args[0] if self.args else "",
+                             self.retryable, self.backoff_s))
+
+
+class BackpressureError(SystemOverloadError):
+    """A submission was shed at admission (bounded intake full). The
+    work never started, so retrying is always safe — a saturated
+    cluster costs latency, never results."""
+
+
+class OutOfMemoryError(SystemOverloadError):
+    """The node memory watchdog killed this task to relieve memory
+    pressure. ``retryable`` reflects the task's own retry policy
+    (``max_retries > 0``); the owner retries retryable victims up to
+    ``task_oom_retries`` with exponential backoff, and surfaces this
+    error at ``get()`` for non-retryable ones."""

@@ -1,0 +1,18 @@
+"""bounded-queue fixture: two unbounded constructions (flagged); the
+bounded and annotated ones pin the false-positive floor."""
+
+import queue
+from collections import deque
+
+
+class Mailbox:
+    def __init__(self):
+        self.items = deque()                    # finding: no maxlen
+        self.waiters = queue.Queue()            # finding: no maxsize
+        self.infinite = queue.Queue(0)          # finding: 0 = infinite
+        self.recent = deque(maxlen=16)
+        self.slots = queue.Queue(maxsize=4)
+        self.ring = deque((), 8)                # positional maxlen
+        # unbounded-ok: drained synchronously by the test loop
+        self.justified = deque()
+        self.inline = queue.Queue()  # unbounded-ok: fixture inline case

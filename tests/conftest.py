@@ -20,6 +20,14 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("RAY_TPU_FAKE_TPUS", "8")
+# Pin the memory watchdog to explicit-total mode with an effectively
+# infinite denominator: REAL readings then never cross the threshold,
+# so exact-count assertions (retries, oom_kills) can't flake on a
+# loaded CI host. Watchdog tests inject readings via the chaos
+# `pressure` action, which bypasses the measurement entirely — they
+# are unaffected. Env var, so spawned raylet/GCS children inherit it.
+os.environ.setdefault("RAY_TPU_memory_watchdog_total_bytes",
+                      str(1 << 60))
 
 import jax
 

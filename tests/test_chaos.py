@@ -380,6 +380,153 @@ def test_worker_killed_mid_task_retries_exactly_once(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# gcs chaos-killed and restarted: re-registration + durable state
+
+
+def test_gcs_killed_and_restarted_state_survives():
+    """Chaos-kill the spawned GCS process, restart it on the SAME port
+    against the same persist_path: raylets re-register through their
+    retrying channels, heartbeats flow end-to-end again, named actors
+    stay resolvable, and KV state survives the restart."""
+    ray_tpu.shutdown()
+    from ray_tpu._private.config import get_config
+    from ray_tpu._private.gcs_server import spawn_gcs_process
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_num_cpus=2, _system_config={
+        "gcs_mode": "process",
+        "health_check_period_ms": 200,
+        # armed in every process, but the component only matches the
+        # GCS server's dispatch — a poison kv_del kills it on demand
+        "chaos_rules": "gcs.dispatch.kv_del:kill@1",
+    })
+    try:
+        w = cluster.worker
+        nid = cluster.add_node(num_cpus=2, resources={"G": 2},
+                               remote=True)
+
+        @ray_tpu.remote
+        class Survivor:
+            def ping(self):
+                return "alive"
+
+        actor = Survivor.options(name="survivor", lifetime="detached",
+                                 resources={"G": 1}).remote()
+        assert ray_tpu.get(actor.ping.remote(), timeout=60) == "alive"
+        w.gcs.kv_put(b"durable", b"payload", "ns")
+        time.sleep(0.8)      # persist loop flush (0.2s cadence)
+
+        old_addr = tuple(w.gcs_address)
+        proc1 = w._gcs_proc
+        try:
+            # dispatching this kills the GCS (chaos kill-at-point);
+            # the short deadline abandons the call without retrying it
+            # into the restarted server
+            w.gcs._call("kv_del", b"sacrifice", "ns", timeout=3)
+        except Exception:
+            pass
+        deadline = time.monotonic() + 10
+        while proc1.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert proc1.poll() == chaos.KILL_EXIT_CODE
+
+        # restart against the same persist_path, on the same port, so
+        # every retrying client reconnects without re-discovery
+        t_restart = time.time()
+        proc2, addr2 = spawn_gcs_process(
+            w.session, get_config().serialize(), persist=True,
+            port=old_addr[1])
+        w._gcs_proc = proc2          # worker.shutdown reaps it
+        assert tuple(addr2) == old_addr
+
+        # the raylet re-registered (GcsClient on_reconnect) and its
+        # heartbeats flow through the restarted GCS to the driver's
+        # re-subscribed channel — end-to-end proof of re-registration
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            ts, _ = w.node_reports.get(nid, (0, None))
+            if ts > t_restart:
+                break
+            time.sleep(0.1)
+        assert w.node_reports.get(nid, (0, None))[0] > t_restart
+
+        # KV survived the kill
+        assert w.gcs.kv_get(b"durable", "ns") == b"payload"
+        # the named actor is still resolvable AND callable
+        again = ray_tpu.get_actor("survivor")
+        assert ray_tpu.get(again.ping.remote(), timeout=60) == "alive"
+    finally:
+        cluster.shutdown()
+        get_config().reset()
+
+
+# ---------------------------------------------------------------------------
+# gcs server hygiene (satellite fixes)
+
+
+def test_gcs_health_loop_prunes_dead_node_clients():
+    """A node declared dead must not leak its health-probe client
+    (socket + reader thread) for the GCS's lifetime."""
+    from ray_tpu._private.config import get_config
+    from ray_tpu._private.gcs import NodeInfo
+    from ray_tpu._private.gcs_server import GcsServer
+    from ray_tpu._private.ids import NodeID
+
+    get_config().apply_system_config({
+        "health_check_period_ms": 100,
+        "health_check_failure_threshold": 2,
+    })
+    try:
+        gcs = GcsServer()
+        victim = RpcServer(component="doomed_raylet")
+        node_id = NodeID.from_random()
+        try:
+            gcs._register_node(
+                None, NodeInfo(node_id=node_id,
+                               resources_total={"CPU": 1.0}),
+                victim.address)
+            deadline = time.monotonic() + 10
+            while node_id not in gcs._health_clients \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert node_id in gcs._health_clients
+            victim.shutdown()       # node dies; pings start failing
+            deadline = time.monotonic() + 15
+            while node_id in gcs._health_clients \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert node_id not in gcs._health_clients   # pruned+closed
+            assert all(not i.alive
+                       for i in gcs.state.get_all_node_info()
+                       if i.node_id == node_id)
+        finally:
+            victim.shutdown()
+            gcs.shutdown()
+    finally:
+        get_config().reset()
+
+
+def test_gcs_shutdown_flushes_final_snapshot(tmp_path):
+    """A mutation landing right before shutdown must reach the
+    snapshot — the persist thread flushes once more on exit and
+    shutdown joins it."""
+    from ray_tpu._private.gcs_server import GcsServer
+
+    path = str(tmp_path / "gcs_state.bin")
+    gcs = GcsServer(persist_path=path)
+    try:
+        gcs.state.kv_put(b"last", b"write", "ns")
+        gcs._dirty.set()     # as the mutating handler wrapper would
+    finally:
+        gcs.shutdown()       # immediately: inside the 0.2s window
+    reborn = GcsServer(persist_path=path)
+    try:
+        assert reborn.state.kv_get(b"last", "ns") == b"write"
+    finally:
+        reborn.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # raylet killed mid-task: node dead -> retry + lineage reconstruction
 
 

@@ -106,6 +106,63 @@ def test_retry_discipline_scoped_to_private_tree(tmp_path):
                 if f.pass_id == "retry-discipline"]) == 1
 
 
+def test_bounded_queue_flags_unbounded_constructions():
+    unsuppressed, _ = _run([_fixture("bad_queue.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "bounded-queue"]
+    # bare deque(), bare Queue(), and Queue(0) — the stdlib's
+    # spelled-out-infinite maxsize — are all flagged
+    assert len(hits) == 3
+    messages = " | ".join(f.message for f in hits)
+    assert "deque()" in messages and "Queue()" in messages
+    assert all(h.context == "Mailbox.__init__" for h in hits)
+
+
+def test_bounded_queue_scoped_to_private_tree(tmp_path):
+    """Outside _private/ (and the fixture tree) the pass stays quiet:
+    library layers buffer user data under user-visible knobs."""
+    mod = tmp_path / "lib.py"
+    mod.write_text("from collections import deque\nq = deque()\n")
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path))
+    assert [f for f in unsuppressed
+            if f.pass_id == "bounded-queue"] == []
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    mod2 = priv / "lib.py"
+    mod2.write_text("from collections import deque\nq = deque()\n")
+    unsuppressed, _ = _run([str(mod2)], root=str(tmp_path))
+    assert len([f for f in unsuppressed
+                if f.pass_id == "bounded-queue"]) == 1
+
+
+def test_bounded_queue_accepts_annotation_block_above(tmp_path):
+    """The unbounded-ok annotation may sit in the contiguous comment
+    block above the construction — but an unrelated comment block, or
+    one separated by code, does not suppress."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    mod = priv / "mod.py"
+    mod.write_text(
+        "from collections import deque\n"
+        "# unbounded-ok: drained by the loop below\n"
+        "a = deque()\n"
+        "# some unrelated comment\n"
+        "b = deque()\n")
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path))
+    hits = [f for f in unsuppressed if f.pass_id == "bounded-queue"]
+    assert len(hits) == 1 and hits[0].line == 5
+    # a CODE line with a trailing comment ends the block: the
+    # annotation above it must not leak through to later constructions
+    mod2 = priv / "mod2.py"
+    mod2.write_text(
+        "from collections import deque\n"
+        "# unbounded-ok: only for the next line\n"
+        "a = deque()  # the annotated one\n"
+        "b = deque()\n")
+    unsuppressed, _ = _run([str(mod2)], root=str(tmp_path))
+    hits = [f for f in unsuppressed if f.pass_id == "bounded-queue"]
+    assert len(hits) == 1 and hits[0].line == 4
+
+
 def test_clean_fixture_produces_zero_findings():
     unsuppressed, all_findings = _run([_fixture("clean.py")])
     assert all_findings == [], [f.render() for f in all_findings]
