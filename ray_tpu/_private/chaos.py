@@ -17,7 +17,10 @@ A **rule** names an event and an action::
 - ``point``: where in the stack — ``send`` / ``recv`` (frame I/O),
   ``dispatch`` (server handler entry), ``spawn`` / ``teardown``
   (worker-pool process lifecycle), ``boot`` / ``exec`` (inside a
-  worker process).
+  worker process), ``rendezvous`` (collective-group rank-file I/O:
+  ``collective.rendezvous.save_<tag>``/``load_<tag>`` with tag in
+  ``ar``/``ag``/``bc``/``bar`` — ``drop`` makes a rank file vanish,
+  ``kill`` dies mid-collective).
 - ``method``: the RPC method / push topic / task name at the event
   (``reply`` for reply frames; empty for lifecycle points).
 - ``action``: ``drop`` (frame vanishes), ``delay=SECONDS`` (stall),
@@ -73,7 +76,7 @@ KILL_EXIT_CODE = 42
 
 ACTIONS = ("drop", "delay", "dup", "sever", "kill", "pressure")
 POINTS = ("send", "recv", "dispatch", "spawn", "teardown", "boot",
-          "exec", "watchdog", "*")
+          "exec", "watchdog", "rendezvous", "*")
 
 _RULE_RE = re.compile(
     r"^(?P<component>[^.:\s]+)\.(?P<point>[^.:\s]+)\.(?P<method>[^:\s]*)"

@@ -207,6 +207,20 @@ _D("task_oom_retries", int, 3,
    "(separate from max_retries; exponential backoff between "
    "attempts).")
 
+# --- gang fault tolerance (collective groups; see
+# docs/fault_tolerance.md "Gang semantics") ---
+_D("gang_max_restarts", int, 1,
+   "Coordinated-restart budget per collective gang: a member-actor "
+   "death aborts the group (epoch bump + CollectiveAbortError to "
+   "in-op ranks) and, while budget remains, kills and restarts ALL "
+   "members together, re-forming the group at the new epoch. 0 = a "
+   "member death kills the gang permanently. Per-group override via "
+   "create_collective_group(gang_max_restarts=...).")
+_D("gang_reform_timeout_s", float, 60.0,
+   "How long a coordinated gang restart waits for every member to be "
+   "ALIVE again (and the re-join barrier to complete) before the gang "
+   "is declared DEAD.")
+
 # --- chaos / fault injection (tests only; see _private/chaos.py) ---
 _D("chaos_rules", str, "",
    "Fault-injection rules (component.point.method:action[...]; "

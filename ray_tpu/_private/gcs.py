@@ -75,6 +75,25 @@ class ActorInfo:
 
 
 @dataclass
+class GangInfo:
+    """One collective gang (TorchElastic-style rendezvous group): its
+    members, incarnation epoch, and lifecycle state. Any member-actor
+    death observed by ``update_actor_state`` bumps the epoch and marks
+    the gang ABORTED — pollers (the driver's gang coordinator, the
+    gang gauges) see the transition without a dedicated death RPC."""
+
+    name: str
+    members: Tuple[ActorID, ...]
+    world_size: int
+    epoch: int = 1
+    state: str = "FORMING"   # FORMING|ALIVE|ABORTED|DEAD
+    max_restarts: int = 0
+    num_aborts: int = 0
+    num_restarts: int = 0
+    death_cause: str = ""
+
+
+@dataclass
 class NodeInfo:
     node_id: NodeID
     resources_total: Dict[str, float] = field(default_factory=dict)
@@ -92,6 +111,7 @@ class GcsLite:
         self._nodes: Dict[NodeID, NodeInfo] = {}
         self._actors: Dict[ActorID, ActorInfo] = {}
         self._named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self._gangs: Dict[str, GangInfo] = {}  # guarded-by: _lock
         self._kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)
         self._job_counter = 0
 
@@ -145,6 +165,7 @@ class GcsLite:
 
     def update_actor_state(self, actor_id: ActorID, state: str,
                            death_cause: str = "") -> None:
+        aborted = []
         with self._lock:
             info = self._actors.get(actor_id)
             if info is None:
@@ -152,7 +173,24 @@ class GcsLite:
             info.state = state
             if death_cause:
                 info.death_cause = death_cause
+            if state in ("DEAD", "RESTARTING"):
+                # Gang fencing: a member death aborts every live gang
+                # it belongs to and bumps the epoch — the previous
+                # incarnation can never rendezvous again. Already
+                # ABORTED/DEAD gangs don't re-bump (the coordinated
+                # restart marks every member RESTARTING).
+                for g in self._gangs.values():
+                    if actor_id in g.members and g.state in ("FORMING",
+                                                             "ALIVE"):
+                        g.state = "ABORTED"
+                        g.epoch += 1
+                        g.num_aborts += 1
+                        g.death_cause = (f"member {actor_id.hex()[:8]} "
+                                         f"{state.lower()}")
+                        aborted.append((g.name, g.epoch))
         self.publisher.publish("ACTOR", (state, actor_id))
+        for name, epoch in aborted:
+            self.publisher.publish("GANG", ("ABORTED", name, epoch))
 
     def update_actor_location(self, actor_id: ActorID,
                               node_id: Optional[NodeID]) -> None:
@@ -176,6 +214,43 @@ class GcsLite:
     def list_actors(self) -> List[ActorInfo]:
         with self._lock:
             return list(self._actors.values())
+
+    # -- gangs (collective groups; see docs/fault_tolerance.md) ------------
+
+    def register_gang(self, info: GangInfo) -> None:
+        with self._lock:
+            self._gangs[info.name] = info
+        self.publisher.publish("GANG", (info.state, info.name, info.epoch))
+
+    def get_gang_info(self, name: str) -> Optional[GangInfo]:
+        with self._lock:
+            return self._gangs.get(name)
+
+    def list_gangs(self) -> List[GangInfo]:
+        with self._lock:
+            return list(self._gangs.values())
+
+    def update_gang_state(self, name: str, state: str,
+                          death_cause: str = "") -> None:
+        """Lifecycle transition by the driver's gang coordinator.
+        ABORTED -> FORMING counts one coordinated restart."""
+        with self._lock:
+            g = self._gangs.get(name)
+            if g is None:
+                return
+            if state == "FORMING" and g.state == "ABORTED":
+                g.num_restarts += 1
+            g.state = state
+            if death_cause:
+                g.death_cause = death_cause
+            epoch = g.epoch
+        self.publisher.publish("GANG", (state, name, epoch))
+
+    def unregister_gang(self, name: str) -> None:
+        with self._lock:
+            g = self._gangs.pop(name, None)
+        if g is not None:
+            self.publisher.publish("GANG", ("REMOVED", name, g.epoch))
 
     # -- internal KV (reference: InternalKVManager) ------------------------
 
@@ -204,6 +279,7 @@ class GcsLite:
                 "nodes": self._nodes,
                 "actors": self._actors,
                 "named_actors": self._named_actors,
+                "gangs": self._gangs,
                 "kv": dict(self._kv),
                 "job_counter": self._job_counter,
             })
@@ -215,5 +291,6 @@ class GcsLite:
             self._nodes = state["nodes"]
             self._actors = state["actors"]
             self._named_actors = state["named_actors"]
+            self._gangs = state.get("gangs", {})  # pre-gang snapshots
             self._kv = defaultdict(dict, state["kv"])
             self._job_counter = state["job_counter"]

@@ -18,7 +18,7 @@ import logging
 import threading
 from typing import Dict, List, Optional, Tuple
 
-from ray_tpu._private.gcs import ActorInfo, NodeInfo, Publisher
+from ray_tpu._private.gcs import ActorInfo, GangInfo, NodeInfo, Publisher
 from ray_tpu._private.ids import ActorID, NodeID
 from ray_tpu._private.rpc import RetryingRpcClient
 
@@ -55,7 +55,7 @@ class GcsClient:
         """Connection-scoped state, rebuilt on every (re)connect: the
         push subscriptions live server-side per connection, and any
         cached actor info may be stale across the gap."""
-        for channel in ("NODE", "ACTOR", "RESOURCES"):
+        for channel in ("NODE", "ACTOR", "RESOURCES", "GANG"):
             raw.call("subscribe", channel, timeout=10.0)
         with self._cache_lock:
             self._actor_cache.clear()
@@ -141,6 +141,28 @@ class GcsClient:
 
     def list_actors(self) -> List[ActorInfo]:
         return self._call("list_actors")
+
+    # -- gangs ---------------------------------------------------------
+    #
+    # Uncached on purpose: gang state is polled on the restart path
+    # (member death → re-form), never on the task hot path, and a
+    # stale epoch read there would defeat the fence.
+
+    def register_gang(self, info: GangInfo) -> None:
+        self._call("register_gang", info)
+
+    def get_gang_info(self, name: str) -> Optional[GangInfo]:
+        return self._call("get_gang_info", name)
+
+    def list_gangs(self) -> List[GangInfo]:
+        return self._call("list_gangs")
+
+    def update_gang_state(self, name: str, state: str,
+                          death_cause: str = "") -> None:
+        self._call("update_gang_state", name, state, death_cause)
+
+    def unregister_gang(self, name: str) -> None:
+        self._call("unregister_gang", name)
 
     # -- internal KV ---------------------------------------------------
 

@@ -87,6 +87,16 @@ class GcsServer:
         s.register("get_named_actor",
                    lambda ctx, name, ns: self.state.get_named_actor(name, ns))
         s.register("list_actors", lambda ctx: self.state.list_actors())
+        s.register("register_gang",
+                   lambda ctx, info: self.state.register_gang(info))
+        s.register("get_gang_info",
+                   lambda ctx, name: self.state.get_gang_info(name))
+        s.register("list_gangs", lambda ctx: self.state.list_gangs())
+        s.register("update_gang_state",
+                   lambda ctx, name, st, cause:
+                   self.state.update_gang_state(name, st, cause))
+        s.register("unregister_gang",
+                   lambda ctx, name: self.state.unregister_gang(name))
         s.register("kv_put", lambda ctx, k, v, ns: self.state.kv_put(k, v, ns))
         s.register("kv_get", lambda ctx, k, ns: self.state.kv_get(k, ns))
         s.register("kv_del", lambda ctx, k, ns: self.state.kv_del(k, ns))
@@ -103,6 +113,8 @@ class GcsServer:
                                        lambda m: self._publish("NODE", m))
         self.state.publisher.subscribe("ACTOR",
                                        lambda m: self._publish("ACTOR", m))
+        self.state.publisher.subscribe("GANG",
+                                       lambda m: self._publish("GANG", m))
 
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="rtpu-gcs-health")
@@ -113,6 +125,8 @@ class GcsServer:
             for method in ("register_node", "remove_node",
                            "register_actor", "update_actor_state",
                            "update_actor_location",
+                           "register_gang", "update_gang_state",
+                           "unregister_gang",
                            "kv_put", "kv_del", "next_job_id"):
                 self._wrap_dirty(method)
             self._persist_thread = threading.Thread(
@@ -304,6 +318,8 @@ def main(argv=None) -> None:
         f.write(f"{server.address[0]}:{server.address[1]}")
     os.rename(tmp, args.port_file)
     try:
+        # no-deadline: serve-forever parent loop; the process exits on
+        # SIGINT/SIGTERM (KeyboardInterrupt) or when the driver reaps it
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:

@@ -2145,6 +2145,8 @@ class NodeManagerGroup:
 
     def _io_loop(self) -> None:
         from multiprocessing.connection import wait as conn_wait
+        # no-deadline: daemon service loop, exits via _shutdown; each
+        # pass blocks at most 0.1s in conn_wait / 0.01s in the idle sleep
         while not self._shutdown:
             conns = []
             with self._lock:

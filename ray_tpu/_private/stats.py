@@ -41,6 +41,17 @@ def install_runtime_metrics() -> None:
     inflight = m.Gauge(
         "ray_tpu_inflight_window",
         "Owner->raylet in-flight lease window usage", tag_keys=("node",))
+    gang_aborts = m.Gauge(
+        "ray_tpu_gang_aborts",
+        "Collective-gang aborts observed by this driver (member death "
+        "or kill fencing off an incarnation)")
+    gang_restarts = m.Gauge(
+        "ray_tpu_gang_restarts",
+        "Coordinated gang restarts started by this driver")
+    gang_epoch = m.Gauge(
+        "ray_tpu_gang_epoch",
+        "Current incarnation epoch per collective gang",
+        tag_keys=("group",))
 
     def collect():
         from ray_tpu._private.worker import try_global_worker
@@ -77,5 +88,10 @@ def install_runtime_metrics() -> None:
             by_state[info.state] = by_state.get(info.state, 0) + 1
         for state, count in by_state.items():
             actors.set(count, tags={"state": state})
+        gang_aborts.set(getattr(w, "num_gang_aborts", 0))
+        gang_restarts.set(getattr(w, "num_gang_restarts", 0))
+        gang_epoch.clear()   # destroyed gangs' series must vanish
+        for g in w.gcs.list_gangs():
+            gang_epoch.set(g.epoch, tags={"group": g.name})
 
     m.register_collector(collect)

@@ -16,6 +16,7 @@ import os
 import threading
 import time
 from collections import deque
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import cloudpickle
@@ -97,6 +98,31 @@ def _detect_num_tpus() -> int:
         return sum(1 for d in jax.devices() if d.platform != "cpu")
     except Exception:
         return 0
+
+
+@dataclass
+class _GangRecord:
+    """Driver-side view of one collective gang: everything the
+    coordinated-restart path needs to kill, respawn, and re-join every
+    member together (see docs/fault_tolerance.md "Gang semantics")."""
+
+    name: str
+    handles: list                    # ActorHandle per member (re-join)
+    actor_ids: list
+    ranks: list
+    world_size: int
+    backend: str
+    restarts_left: int
+    epoch: int = 1
+    # a coordinated restart is in flight: further member deaths fold
+    # into it instead of starting another
+    restarting: bool = False
+    # actor-queue flush gate: queued user calls must not reach a
+    # restarted member before its re-join call re-forms the group
+    gated: bool = False
+    # terminally dead (budget exhausted, member killed, re-form
+    # failed): no further coordinated restart may run for this gang
+    dead: bool = False
 
 
 class Worker:
@@ -260,6 +286,17 @@ class Worker:
         self._actor_specs: Dict[ActorID, TaskSpec] = {}  # guarded-by: _actor_lock
         self._actor_restarts: Dict[ActorID, int] = {}  # guarded-by: _actor_lock
         self._actor_flush_locks: Dict[ActorID, threading.RLock] = {}  # guarded-by: _actor_lock
+        # kill tombstones: ray_tpu.kill() must beat a creation spec a
+        # concurrent _on_actor_death already resubmitted (satellite:
+        # kill/restart race) — checked before any restart/revival
+        self._actor_tombstones: set = set()  # guarded-by: _actor_lock
+        # collective gangs (coordinated SPMD restart; see
+        # docs/fault_tolerance.md "Gang semantics")
+        self._gang_lock = threading.Lock()
+        self._gangs: Dict[str, _GangRecord] = {}  # guarded-by: _gang_lock
+        self._actor_gang: Dict[ActorID, str] = {}  # guarded-by: _gang_lock
+        self.num_gang_aborts = 0
+        self.num_gang_restarts = 0
         self._actor_flush_wake = threading.Event()
         self._actor_flusher = threading.Thread(
             target=self._actor_flush_loop, daemon=True,
@@ -1212,7 +1249,17 @@ class Worker:
                     self._fail_task(spec, ActorDiedError(
                         "actor is dead; cannot retry task"))
                     return
-                queue.appendleft(spec)
+                # Re-queue in per-caller submission order: several
+                # in-flight calls failing together (worker death)
+                # resubmit one by one, and bare appendleft would
+                # reverse them. Insert by sequence_number so the
+                # replayed batch flushes in its original order.
+                pos = 0
+                while (pos < len(queue)
+                       and queue[pos].sequence_number
+                       < spec.sequence_number):
+                    pos += 1
+                queue.insert(pos, spec)
             self._flush_actor_queues()
         else:
             self.node_group.submit_task(spec)
@@ -1397,6 +1444,17 @@ class Worker:
                                 system_error) -> None:
         actor_id = spec.actor_creation_id
         if err_blob is None and system_error is None:
+            with self._actor_lock:
+                tombstoned = actor_id in self._actor_tombstones
+            if tombstoned:
+                # kill/restart race, kill wins: a creation resubmitted
+                # before ray_tpu.kill() landed completed anyway — reap
+                # the revived worker and keep the actor DEAD.
+                self.node_group.release_actor(actor_id, kill_worker=True)
+                self.gcs.update_actor_state(actor_id, "DEAD",
+                                            death_cause="killed")
+                self._fail_actor_queue(actor_id, None)
+                return
             if spec.lifetime == "detached":
                 # Publish the hosting raylet so later drivers can
                 # route calls to this actor after we exit.
@@ -1522,6 +1580,10 @@ class Worker:
     _ACTOR_FLUSH_BATCH = 256   # max calls per wire frame
 
     def _flush_one_actor(self, actor_id: ActorID) -> None:
+        if self._gang_flush_gated(actor_id):
+            # gang restart in flight: queued calls must not reach the
+            # member before its re-join call re-forms the group
+            return
         info = self.gcs.get_actor_info(actor_id)
         if info is None or info.state != "ALIVE":
             return
@@ -1541,6 +1603,11 @@ class Worker:
         batched frame per round — the submit half of the batched actor
         wire path. Flush-lock held by the caller."""
         while True:
+            if self._gang_flush_gated(actor_id):
+                # a gang restart began after the caller's gate check:
+                # stop popping so queued calls stay queued (and survive
+                # the restart) instead of shipping into the kill window
+                return
             batch: List[TaskSpec] = []
             with self._actor_lock:
                 queue = self._actor_queues.get(actor_id)
@@ -1657,13 +1724,265 @@ class Worker:
         rec = self.task_manager.get_record(task_id)
         return rec is not None and rec.cancelled
 
+    # -- collective gangs (coordinated SPMD restart) ---------------------
+
+    def register_gang(self, name: str, handles: list, ranks: list,
+                      world_size: int, backend: str,
+                      max_restarts: Optional[int] = None,
+                      epoch: int = 1) -> None:
+        """Record a collective gang (called by
+        ``collective.create_collective_group``): member deaths from
+        here on are handled collectively — abort + epoch fence + a
+        coordinated kill-and-restart of every member. ``epoch`` starts
+        past a reused name's previous incarnation."""
+        if max_restarts is None:
+            max_restarts = get_config().gang_max_restarts
+        actor_ids = [h._actor_id for h in handles]
+        rec = _GangRecord(name=name, handles=list(handles),
+                          actor_ids=actor_ids, ranks=list(ranks),
+                          world_size=world_size, backend=backend,
+                          restarts_left=max_restarts, epoch=epoch)
+        with self._gang_lock:
+            self._gangs[name] = rec
+            for aid in actor_ids:
+                self._actor_gang[aid] = name
+        from ray_tpu._private.gcs import GangInfo
+        self.gcs.register_gang(GangInfo(
+            name=name, members=tuple(actor_ids), world_size=world_size,
+            max_restarts=max_restarts, epoch=epoch))
+
+    def gang_formed(self, name: str) -> None:
+        self.gcs.update_gang_state(name, "ALIVE")
+
+    def unregister_gang(self, name: str) -> None:
+        with self._gang_lock:
+            rec = self._gangs.pop(name, None)
+            if rec is not None:
+                for aid in rec.actor_ids:
+                    if self._actor_gang.get(aid) == name:
+                        self._actor_gang.pop(aid, None)
+        if rec is not None:
+            self.gcs.unregister_gang(name)
+
+    def _gang_flush_gated(self, actor_id: ActorID) -> bool:
+        with self._gang_lock:
+            name = self._actor_gang.get(actor_id)
+            rec = self._gangs.get(name) if name is not None else None
+            return rec is not None and rec.gated
+
+    def _on_gang_member_death(self, name: str, actor_id: ActorID) -> bool:
+        """Collective handling of one member's death. Returns True when
+        the gang path owns the event (the individual restart path must
+        not also run). The decision is made atomically under
+        ``_gang_lock``; the blocking work (GCS RPCs, rendezvous
+        filesystem writes, task submission) runs after it is released
+        — the lock also gates every actor flush, so a stalled GCS
+        channel must not freeze the flusher."""
+        from ray_tpu import collective as _col
+        from ray_tpu._private import export
+        with self._gang_lock:
+            rec = self._gangs.get(name)
+            if rec is None:
+                return False
+            with self._actor_lock:
+                tombstoned = actor_id in self._actor_tombstones
+                creation = self._actor_specs.get(actor_id)
+            if rec.restarting and not tombstoned:
+                mode = "fold"
+            elif (tombstoned or rec.dead or rec.restarts_left == 0
+                    or creation is None):
+                mode = "dead"
+                was_dead = rec.dead
+                rec.dead = True
+                if not was_dead:
+                    self.num_gang_aborts += 1
+            else:
+                mode = "restart"
+                rec.restarting = True
+                rec.gated = True
+                rec.restarts_left -= 1
+                self.num_gang_aborts += 1
+                self.num_gang_restarts += 1
+            old_epoch = rec.epoch
+        if mode == "fold":
+            # a coordinated restart is already re-forming this gang:
+            # fold the death in (respawn just this member; the watcher
+            # keeps waiting for it to come back ALIVE)
+            self.gcs.update_actor_state(actor_id, "RESTARTING")
+            export.emit("ACTOR", {"actor_id": actor_id.hex(),
+                                  "state": "RESTARTING"})
+            if creation is not None:
+                self.task_manager.add_pending_task(creation)
+                self.node_group.submit_task(creation)
+            return True
+        root = _col.group_root(name)
+        if mode == "dead":
+            # budget exhausted, gang already dead, or the user killed a
+            # member: no (further) restart. Callers see ActorDiedError
+            # on the dead member and CollectiveAbortError in any in-op
+            # rank.
+            cause = ("member killed" if tombstoned
+                     else "gang is dead" if was_dead
+                     else "gang restart budget exhausted")
+            self.gcs.update_actor_state(actor_id, "DEAD",
+                                        death_cause=cause)
+            export.emit("ACTOR", {"actor_id": actor_id.hex(),
+                                  "state": "DEAD", "cause": cause})
+            if not was_dead:
+                # gang-level transition happens once; later member
+                # deaths of an already-dead gang only reap that member
+                _col.write_abort_marker(root, old_epoch, cause)
+                self.gcs.update_gang_state(name, "DEAD",
+                                           death_cause=cause)
+            self._fail_actor_queue(actor_id, None)
+            return True
+        # abort this incarnation and restart the whole gang. rec's
+        # epoch/restarting/gated fields now have a single writer (this
+        # path claimed rec.restarting above).
+        # RESTARTING trips the GCS gang hook: ABORTED + epoch bump
+        self.gcs.update_actor_state(actor_id, "RESTARTING")
+        export.emit("ACTOR", {"actor_id": actor_id.hex(),
+                              "state": "RESTARTING"})
+        info = self.gcs.get_gang_info(name)
+        rec.epoch = info.epoch if info is not None else old_epoch + 1
+        _col.write_abort_marker(
+            root, old_epoch,
+            f"member {actor_id.hex()[:8]} died; gang restarting at "
+            f"epoch {rec.epoch}")
+        export.emit("GANG", {"group": name, "state": "ABORTED",
+                             "epoch": rec.epoch})
+        self.task_manager.add_pending_task(creation)
+        self.node_group.submit_task(creation)
+        threading.Thread(
+            target=self._gang_restart_worker,
+            args=(rec, actor_id), daemon=True,
+            name=f"rtpu-gang-restart-{name[:16]}").start()
+        return True
+
+    def _gang_restart_worker(self, rec: _GangRecord,
+                             dead_id: ActorID) -> None:
+        """Coordinated restart: drain, kill every surviving member,
+        wait for the whole gang to be ALIVE again, then re-form the
+        group at the bumped epoch (TorchElastic-style rendezvous
+        round). Runs on its own thread — the death callback that
+        spawned it must not block the node IO loop."""
+        from ray_tpu import collective as _col
+        from ray_tpu._private import export
+        name = rec.name
+        root = _col.group_root(name)
+        survivors = [aid for aid in rec.actor_ids if aid != dead_id]
+        try:
+            # 1. drain: the abort marker reaches in-op ranks within
+            # milliseconds, so their in-flight calls finish (with
+            # CollectiveAbortError) instead of dying as ActorDiedError
+            # under the kill below.
+            drain_deadline = time.monotonic() + 3.0
+            while time.monotonic() < drain_deadline:
+                with self.node_group._lock:
+                    busy = any(
+                        rt.spec.task_type == TaskType.ACTOR_TASK
+                        and rt.spec.actor_id in survivors
+                        for rt in self.node_group._running.values())
+                if not busy:
+                    break
+                time.sleep(0.01)
+            # 2. kill-and-resubmit every survivor together: gang
+            # semantics are all-or-nothing — a fresh epoch starts from
+            # fresh member state.
+            for aid in survivors:
+                self.gcs.update_actor_state(aid, "RESTARTING")
+                export.emit("ACTOR", {"actor_id": aid.hex(),
+                                      "state": "RESTARTING"})
+                self.node_group.release_actor(aid, kill_worker=True)
+                with self._actor_lock:
+                    creation = self._actor_specs.get(aid)
+                if creation is not None:
+                    self.task_manager.add_pending_task(creation)
+                    self.node_group.submit_task(creation)
+            # 3. scrub the previous incarnation's rendezvous artifacts
+            # (generation dirs, rank files, old abort markers): nothing
+            # stale may leak — or collide — under the new epoch.
+            _col.cleanup_stale_epochs(root, rec.epoch)
+            # 4. the gang re-forms only once EVERY member is back
+            deadline = (time.monotonic()
+                        + get_config().gang_reform_timeout_s)
+            while time.monotonic() < deadline:
+                states = [getattr(self.gcs.get_actor_info(aid), "state",
+                                  "DEAD") for aid in rec.actor_ids]
+                if any(s == "DEAD" for s in states):
+                    break
+                if all(s == "ALIVE" for s in states):
+                    break
+                time.sleep(0.05)
+            else:
+                states = ["TIMEOUT"]
+            if not all(s == "ALIVE" for s in states):
+                cause = (f"gang re-form failed: member states {states}")
+                logger.warning("%s: %s", name, cause)
+                rec.dead = True
+                _col.write_abort_marker(root, rec.epoch, cause)
+                self.gcs.update_gang_state(name, "DEAD",
+                                           death_cause=cause)
+                return
+            # 5. re-join at the new epoch, ahead of any queued user
+            # calls: the join specs are moved to each member's queue
+            # front before the flush gate opens.
+            _col.write_group_state(root, rec.epoch, rec.world_size,
+                                   "FORMING")
+            self.gcs.update_gang_state(name, "FORMING")
+            join_refs = []
+            for handle, rank in zip(rec.handles, rec.ranks):
+                ref = handle._join_collective_group.remote(
+                    rec.world_size, rank, rec.backend, name)
+                join_refs.append(ref)
+                join_tid = ref.id().task_id()
+                with self._actor_lock:
+                    q = self._actor_queues.get(handle._actor_id)
+                    if q:
+                        for spec in list(q):
+                            if spec.task_id == join_tid:
+                                q.remove(spec)
+                                # seq 0: a straggler retry re-queued by
+                                # _resubmit's ordered insert (user seqs
+                                # start at 1) can never slot in ahead
+                                # of the re-join
+                                spec.sequence_number = 0
+                                q.appendleft(spec)
+                                break
+            rec.gated = False
+            self._flush_actor_queues()
+            remaining = max(1.0, deadline - time.monotonic())
+            self.get(join_refs, timeout=remaining)
+            _col.write_group_state(root, rec.epoch, rec.world_size,
+                                   "ALIVE")
+            self.gcs.update_gang_state(name, "ALIVE")
+            export.emit("GANG", {"group": name, "state": "ALIVE",
+                                 "epoch": rec.epoch})
+            logger.info("gang %s re-formed at epoch %d", name, rec.epoch)
+        except Exception as e:
+            cause = f"gang restart failed: {e!r}"
+            logger.exception("gang %s restart failed", name)
+            rec.dead = True
+            _col.write_abort_marker(root, rec.epoch, cause)
+            self.gcs.update_gang_state(name, "DEAD", death_cause=cause)
+        finally:
+            rec.restarting = False
+            rec.gated = False
+            self._flush_actor_queues()
+
     def _on_actor_death(self, actor_id: ActorID) -> None:
         from ray_tpu._private import export
+        with self._gang_lock:
+            gang_name = self._actor_gang.get(actor_id)
+        if gang_name is not None and \
+                self._on_gang_member_death(gang_name, actor_id):
+            return
         with self._actor_lock:
             restarts_left = self._actor_restarts.get(actor_id, 0)
             creation = self._actor_specs.get(actor_id)
+            tombstoned = actor_id in self._actor_tombstones
         info = self.gcs.get_actor_info(actor_id)
-        if restarts_left != 0 and creation is not None:
+        if restarts_left != 0 and creation is not None and not tombstoned:
             if restarts_left > 0:
                 with self._actor_lock:
                     self._actor_restarts[actor_id] = restarts_left - 1
@@ -1708,12 +2027,32 @@ class Worker:
                         # below still marks the actor dead
         with self._actor_lock:
             self._actor_restarts[actor_id] = 0
+            # Tombstone: a creation spec a concurrent _on_actor_death
+            # already resubmitted must not revive this actor — kill
+            # wins (checked in _on_actor_death/_on_actor_creation_done).
+            self._actor_tombstones.add(actor_id)
         self.node_group.release_actor(actor_id, kill_worker=True)
         self.gcs.update_actor_state(actor_id, "DEAD", death_cause="killed")
         from ray_tpu._private import export
         export.emit("ACTOR", {"actor_id": actor_id.hex(),
                               "state": "DEAD", "cause": "killed"})
         self._fail_actor_queue(actor_id, None)
+        # A killed gang member takes its gang down: fence the epoch and
+        # fan CollectiveAbortError out to any in-op ranks (the user
+        # chose to kill; the gang does not restart over it).
+        with self._gang_lock:
+            gang_name = self._actor_gang.get(actor_id)
+            rec = self._gangs.get(gang_name) if gang_name else None
+            gang_was_dead = rec.dead if rec is not None else True
+            if rec is not None:
+                rec.dead = True     # no restart may revive this gang
+        if rec is not None and not gang_was_dead:
+            from ray_tpu import collective as _col
+            _col.write_abort_marker(
+                _col.group_root(gang_name), rec.epoch,
+                f"member {actor_id.hex()[:8]} killed")
+            self.gcs.update_gang_state(gang_name, "DEAD",
+                                       death_cause="member killed")
 
     # ------------------------------------------------------------------
     # lifecycle

@@ -7,20 +7,28 @@ from ray_tpu.collective.collective import (
     allreduce,
     barrier,
     broadcast,
+    cleanup_stale_epochs,
     create_collective_group,
     destroy_collective_group,
     get_collective_group_size,
+    get_group_epoch,
     get_rank,
+    group_root,
     init_collective_group,
     recv,
     reducescatter,
     send,
+    write_abort_marker,
+    write_group_state,
 )
+from ray_tpu.exceptions import CollectiveAbortError
 from ray_tpu.collective import xla
 
 __all__ = [
-    "ReduceOp", "allgather", "allreduce", "barrier", "broadcast",
+    "CollectiveAbortError", "ReduceOp", "allgather", "allreduce",
+    "barrier", "broadcast", "cleanup_stale_epochs",
     "create_collective_group", "destroy_collective_group",
-    "get_collective_group_size", "get_rank", "init_collective_group",
-    "recv", "reducescatter", "send", "xla",
+    "get_collective_group_size", "get_group_epoch", "get_rank",
+    "group_root", "init_collective_group", "recv", "reducescatter",
+    "send", "write_abort_marker", "write_group_state", "xla",
 ]

@@ -6,7 +6,7 @@ The runtime under ``ray_tpu/_private`` is a layered concurrent system
 every class of advisor finding so far — unlocked mutations, state
 recorded before an RPC outcome is known, client/server RPC drift — is
 statically detectable. This framework turns those one-off catches into
-a permanent ratchet: six passes (see ``passes/``) run over the tree,
+a permanent ratchet: eight passes (see ``passes/``) run over the tree,
 unsuppressed findings fail the build (tier-1 runs the suite via
 ``tests/test_static_analysis.py``).
 

@@ -9,6 +9,7 @@ def load_passes() -> List:
     from ray_tpu.devtools.analysis.passes import (
         async_blocking,
         bounded_queue,
+        deadline_discipline,
         lock_discipline,
         ref_leak,
         retry_discipline,
@@ -17,4 +18,4 @@ def load_passes() -> List:
     )
     return [lock_discipline, async_blocking, rpc_surface,
             silent_exception, ref_leak, retry_discipline,
-            bounded_queue]
+            bounded_queue, deadline_discipline]

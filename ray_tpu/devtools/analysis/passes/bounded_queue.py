@@ -16,8 +16,8 @@ the raylet's bounded scheduler intake). The rule is structural: inside
   contiguous comment block directly above it (reasons are sentences;
   they don't fit end-of-line).
 
-Only ``_private/`` (and the lint fixtures) are in scope; library
-layers buffer user data under user-visible knobs.
+Only ``_private/`` and ``collective/`` (and the lint fixtures) are in
+scope; library layers buffer user data under user-visible knobs.
 """
 
 from __future__ import annotations
@@ -28,9 +28,9 @@ from typing import List
 from ray_tpu.devtools.analysis.core import FileContext, Finding, attr_tail
 
 PASS_ID = "bounded-queue"
-VERSION = 1
+VERSION = 2
 
-_SCOPES = ("_private/", "analysis_fixtures/")
+_SCOPES = ("_private/", "collective/", "analysis_fixtures/")
 
 _SUPPRESS_MARK = "unbounded-ok:"
 

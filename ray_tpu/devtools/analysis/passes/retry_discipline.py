@@ -16,8 +16,9 @@ every ``.call(...)`` whose method is a string literal must either
 
 Wrapper calls whose method is a variable (``self._client.call(method,
 ...)``) are the wrapper's problem — the wrapper's own literal sites
-are checked. Only ``_private/`` (and the lint fixtures) are in scope:
-the library layers talk through already-deadlined seams.
+are checked. Only ``_private/`` and ``collective/`` (and the lint
+fixtures) are in scope: the library layers talk through
+already-deadlined seams.
 """
 
 from __future__ import annotations
@@ -28,11 +29,12 @@ from typing import List
 from ray_tpu.devtools.analysis.core import FileContext, Finding
 
 PASS_ID = "retry-discipline"
-VERSION = 1
+VERSION = 2
 
-# Enforced scopes: the runtime core, plus the lint fixture tree (the
-# self-test floor in tests/analysis_fixtures/).
-_SCOPES = ("_private/", "analysis_fixtures/")
+# Enforced scopes: the runtime core, the collective/gang plane, plus
+# the lint fixture tree (the self-test floor in
+# tests/analysis_fixtures/).
+_SCOPES = ("_private/", "collective/", "analysis_fixtures/")
 
 _SUPPRESS_MARK = "no-deadline:"
 

@@ -50,12 +50,25 @@ class TaskError(RayTpuError):
         if self.cause is None:
             return self
         cause_cls = type(self.cause)
-        if cause_cls in (TaskError,) or issubclass(cause_cls, RayTpuError):
+        # Framework errors normally stay wrapped (their constructors
+        # don't all accept the TaskError signature); ones that opt in
+        # via _typed_across_tasks (CollectiveAbortError) derive too, so
+        # `except CollectiveAbortError` works at the caller's get().
+        if cause_cls in (TaskError,) or (
+                issubclass(cause_cls, RayTpuError)
+                and not getattr(self.cause, "_typed_across_tasks", False)):
             return self
         try:
             derived = type("TaskError_" + cause_cls.__name__,
                            (TaskError, cause_cls), {})
             err = derived(self.cause, self.task_repr, self.traceback_str)
+            # The derived instance was built by TaskError.__init__, so
+            # the cause's own attributes (CollectiveAbortError's
+            # group/epoch, user exception fields) were never set — copy
+            # them over, without clobbering the TaskError fields.
+            for key, value in vars(self.cause).items():
+                if key not in ("cause", "task_repr", "traceback_str"):
+                    setattr(err, key, value)
             return err
         except Exception:
             return self
@@ -161,3 +174,30 @@ class OutOfMemoryError(SystemOverloadError):
     (``max_retries > 0``); the owner retries retryable victims up to
     ``task_oom_retries`` with exponential backoff, and surfaces this
     error at ``get()`` for non-retryable ones."""
+
+
+class CollectiveAbortError(RayTpuError):
+    """A collective group was aborted mid-operation: a member died (or
+    the gang's epoch was fenced off) while this rank was inside a
+    rendezvous. Retryable by contract — the operation transferred no
+    partial results, and the gang re-forms at a bumped epoch (see
+    docs/fault_tolerance.md "Gang semantics"); callers re-issue the
+    collective once the gang is ALIVE again.
+
+    ``group``/``epoch`` name the aborted incarnation. Raised inside
+    actor methods it surfaces TYPED at the caller's ``get()``
+    (``_typed_across_tasks``), so `except CollectiveAbortError` is the
+    retry trigger."""
+
+    retryable = True
+    _typed_across_tasks = True
+
+    def __init__(self, msg: str = "collective group aborted",
+                 group: str = "", epoch: int = 0):
+        super().__init__(msg)
+        self.group = group
+        self.epoch = int(epoch)
+
+    def __reduce__(self):
+        return (type(self), (self.args[0] if self.args else "",
+                             self.group, self.epoch))

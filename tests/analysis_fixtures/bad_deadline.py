@@ -1,0 +1,30 @@
+"""Fixture: deadline-discipline violations (and non-violations)."""
+
+import time
+
+
+class Poller:
+    def __init__(self):
+        self.done = False
+
+    def bad(self, path):
+        import os
+        # a sleep-poll loop with no clock: spins forever once `path`
+        # can no longer appear
+        while not os.path.exists(path):
+            time.sleep(0.01)
+        return True
+
+    def good(self, path):
+        import os
+        deadline = time.monotonic() + 5.0
+        while not os.path.exists(path):
+            if time.monotonic() > deadline:
+                raise TimeoutError(path)
+            time.sleep(0.01)
+        return True
+
+    def annotated(self):
+        # no-deadline: daemon service loop, exits via the done flag
+        while not self.done:
+            time.sleep(0.05)

@@ -380,6 +380,107 @@ def test_worker_killed_mid_task_retries_exactly_once(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# actor chaos-killed mid-method: retries replay in per-caller order
+
+
+def test_actor_chaos_kill_replays_calls_in_order(tmp_path):
+    """Satellite: chaos-kill an actor's worker at the 3rd method exec;
+    with max_restarts + max_task_retries the actor restarts and every
+    in-flight/queued call replays — in per-caller submission order
+    (sequence_number), with exactly one side effect per call (the
+    killed attempt died at exec entry, before user code ran)."""
+    ray_tpu.shutdown()
+    marker = tmp_path / "order.txt"
+    # one-process pool: the pool spawns ahead during creation retries,
+    # and a second worker spawned while the env rule is set would stay
+    # armed and kill the RESTARTED actor too
+    w = ray_tpu.init(num_cpus=2, max_process_workers=1)
+    try:
+        @ray_tpu.remote(max_restarts=1, max_task_retries=2)
+        class Seq:
+            def ping(self):
+                return "up"
+
+            def mark(self, path, i):
+                with open(path, "a") as f:
+                    f.write(f"{i}\n")
+                return i
+
+        # Arm ONLY this actor's worker: rule rides the env into the
+        # spawn; the restarted worker spawns clean after the pop.
+        os.environ[chaos.ENV_VAR] = "worker.exec.Seq.mark:kill@3"
+        a = Seq.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "up"
+        os.environ.pop(chaos.ENV_VAR)
+
+        refs = [a.mark.remote(str(marker), i) for i in range(8)]
+        assert ray_tpu.get(refs, timeout=120) == list(range(8))
+        # per-caller ordering survived the restart: the failed batch
+        # re-queued by sequence_number, not reversed
+        assert marker.read_text().splitlines() == [str(i)
+                                                   for i in range(8)]
+        assert w.task_manager.num_retries >= 1
+        info = w.gcs.get_actor_info(a._actor_id)
+        assert info.num_restarts == 1
+    finally:
+        os.environ.pop(chaos.ENV_VAR, None)
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# kill/restart race: ray_tpu.kill() must beat an in-flight restart
+
+
+def test_kill_wins_over_inflight_restart(tmp_path):
+    """Satellite regression: kill_actor zeroes the restart budget, but
+    a creation spec already resubmitted by _on_actor_death could
+    complete afterwards and revive the actor. The kill tombstone must
+    win: the actor stays DEAD and the revived worker is reaped."""
+    ray_tpu.shutdown()
+    gate = tmp_path / "slow_restart"
+    w = ray_tpu.init(num_cpus=2, max_process_workers=2)
+    try:
+        @ray_tpu.remote(max_restarts=5)
+        class Phoenix:
+            def __init__(self, gate):
+                import os as _os
+                import time as _time
+                if _os.path.exists(gate):   # slow on RESTART only
+                    _time.sleep(1.5)
+
+            def ping(self):
+                return "alive"
+
+        a = Phoenix.remote(str(gate))
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "alive"
+        gate.write_text("x")
+
+        # crash the worker abruptly: _on_actor_death resubmits the
+        # (now slow) creation spec
+        worker = w.node_group.actor_worker(a._actor_id)
+        worker.proc.kill()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            info = w.gcs.get_actor_info(a._actor_id)
+            if info.state == "RESTARTING":
+                break
+            time.sleep(0.02)
+        assert w.gcs.get_actor_info(a._actor_id).state == "RESTARTING"
+
+        ray_tpu.kill(a)     # while the resubmitted creation is in flight
+        time.sleep(3.0)     # let the slow creation land (and lose)
+
+        info = w.gcs.get_actor_info(a._actor_id)
+        assert info.state == "DEAD"
+        assert w.node_group.actor_worker(a._actor_id) is None
+        from ray_tpu.exceptions import ActorDiedError
+        with pytest.raises(ActorDiedError):
+            ray_tpu.get(a.ping.remote(), timeout=30)
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # gcs chaos-killed and restarted: re-registration + durable state
 
 

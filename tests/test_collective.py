@@ -1,20 +1,41 @@
 """Actor-level collective groups (reference: ray.util.collective tests)
-and the XLA device-plane helpers on a fake 8-device mesh."""
+and the XLA device-plane helpers on a fake 8-device mesh.
+
+Gang fault tolerance (docs/fault_tolerance.md "Gang semantics"): a
+member chaos-killed mid-allreduce aborts every surviving rank with a
+retryable CollectiveAbortError in well under the group timeout, the
+gang restarts once with the epoch bumped, and the old incarnation's
+artifacts are both cleaned up and provably unable to satisfy the new
+epoch's rendezvous."""
+
+import os
+import time
 
 import numpy as np
 import pytest
 
 import ray_tpu
 from ray_tpu import collective as col
+from ray_tpu._private import chaos
+from ray_tpu.exceptions import CollectiveAbortError
 
 
 @ray_tpu.remote
 class Member:
+    def ping(self):
+        return "up"
+
     def _join_collective_group(self, world, rank, backend, name):
+        # Join timeout well under the get() timeouts below: a member
+        # crash aborts peers via the liveness marker in milliseconds,
+        # so the rendezvous deadline is a backstop, not the fast path.
         col.init_collective_group(world, rank, backend, name,
-                                  timeout_s=30.0)
+                                  timeout_s=20.0)
         self._group = name
         return rank
+
+    def group_epoch(self):
+        return col.get_group_epoch(self._group)
 
     def do_allreduce(self, value):
         return col.allreduce(np.asarray(value, np.float32), self._group)
@@ -44,40 +65,211 @@ class Member:
 def members(ray_start_regular):
     ms = [Member.options(num_cpus=0.5).remote() for _ in range(2)]
     name = col.create_collective_group(ms, world_size=2, ranks=[0, 1])
-    yield ms
+    yield ms, name
     ray_tpu.get([m.leave.remote() for m in ms], timeout=30)
+    col.destroy_collective_group(name)   # driver side: gang record too
 
 
 def test_allreduce_and_allgather(members):
+    ms, _ = members
+    # A member crash now fails these gets in seconds via the abort
+    # marker (liveness-aware _wait_load), so the old 60s worst-case
+    # get timeouts are down to a bound that keeps tier-1 wall-clock
+    # tight even when something does break.
     outs = ray_tpu.get(
         [m.do_allreduce.remote([float(i + 1)] * 3)
-         for i, m in enumerate(members)], timeout=60)
+         for i, m in enumerate(ms)], timeout=30)
     for o in outs:
         np.testing.assert_allclose(o, [3.0, 3.0, 3.0])
     gathers = ray_tpu.get(
-        [m.do_allgather.remote([float(i)]) for i, m in enumerate(members)],
-        timeout=60)
+        [m.do_allgather.remote([float(i)]) for i, m in enumerate(ms)],
+        timeout=30)
     for g in gathers:
         np.testing.assert_allclose(np.concatenate(g), [0.0, 1.0])
 
 
 def test_reducescatter_broadcast_sendrecv(members):
+    ms, _ = members
     outs = ray_tpu.get(
         [m.do_reducescatter.remote([1.0, 2.0, 3.0, 4.0])
-         for m in members], timeout=60)
+         for m in ms], timeout=30)
     np.testing.assert_allclose(outs[0], [2.0, 4.0])
     np.testing.assert_allclose(outs[1], [6.0, 8.0])
 
     outs = ray_tpu.get(
         [m.do_broadcast.remote([float(i) * 7], 1)
-         for i, m in enumerate(members)], timeout=60)
+         for i, m in enumerate(ms)], timeout=30)
     for o in outs:
         np.testing.assert_allclose(o, [7.0])
 
-    r_send = members[0].do_sendrecv.remote([5.0, 6.0], 1, True)
-    r_recv = members[1].do_sendrecv.remote(None, 0, False)
-    ray_tpu.get(r_send, timeout=60)
-    np.testing.assert_allclose(ray_tpu.get(r_recv, timeout=60), [5.0, 6.0])
+    r_send = ms[0].do_sendrecv.remote([5.0, 6.0], 1, True)
+    r_recv = ms[1].do_sendrecv.remote(None, 0, False)
+    ray_tpu.get(r_send, timeout=30)
+    np.testing.assert_allclose(ray_tpu.get(r_recv, timeout=30),
+                               [5.0, 6.0])
+
+
+def test_destroy_cleans_rendezvous_dir(members):
+    """Leak check: generation dirs and rank files live under the group
+    root; destroy tears the whole root down so group-name reuse can
+    never collide with stale artifacts."""
+    ms, name = members
+    ray_tpu.get([m.do_allreduce.remote([1.0]) for m in ms], timeout=30)
+    root = col.group_root(name)
+    assert os.path.isdir(root)
+    assert any(p.startswith("ep_") for p in os.listdir(root))
+    ray_tpu.get([m.leave.remote() for m in ms], timeout=30)
+    assert not os.path.exists(root)     # nothing leaks on destroy
+
+
+def _armed_member_pair():
+    """(doomed, survivor) Member actors where ONLY the doomed one's
+    worker process carries the mid-allreduce chaos kill rule. The
+    runtime must run with max_process_workers=1: the pool spawns ahead
+    during creation retries, and a second worker spawned while the env
+    rule is set would arm the survivor too."""
+    os.environ[chaos.ENV_VAR] = "collective.rendezvous.save_ar:kill@1"
+    try:
+        doomed = Member.options(num_cpus=0.5).remote()
+        assert ray_tpu.get(doomed.ping.remote(), timeout=60) == "up"
+    finally:
+        os.environ.pop(chaos.ENV_VAR, None)
+    survivor = Member.options(num_cpus=0.5).remote()
+    assert ray_tpu.get(survivor.ping.remote(), timeout=60) == "up"
+    return doomed, survivor
+
+
+def test_gang_member_death_aborts_restarts_and_fences():
+    """Acceptance: a gang member chaos-killed mid-allreduce
+
+    - aborts every surviving rank with CollectiveAbortError well under
+      the group timeout (< 5s; the join deadline is 20s),
+    - triggers ONE coordinated gang restart with the epoch bumped,
+    - a post-restart allreduce at the new epoch returns correct values,
+    - an injected stale-epoch rank file from the old incarnation is
+      provably ignored (correct results, no hang), and
+    - the gang gauges move.
+    """
+    ray_tpu.shutdown()
+    w = ray_tpu.init(num_cpus=4, num_tpus=8, max_process_workers=1)
+    doomed, survivor = _armed_member_pair()
+    ms = [doomed, survivor]
+    name = col.create_collective_group(ms, world_size=2, ranks=[0, 1],
+                                       gang_max_restarts=1)
+    try:
+        info = w.gcs.get_gang_info(name)
+        assert info.state == "ALIVE" and info.epoch == 1
+
+        t0 = time.monotonic()
+        r0 = doomed.do_allreduce.remote([1.0])
+        r1 = survivor.do_allreduce.remote([2.0])
+        # rank 0 dies at the rank-file save (chaos kill): its own call
+        # fails with a system error...
+        with pytest.raises(Exception) as exc0:
+            ray_tpu.get(r0, timeout=30)
+        assert not isinstance(exc0.value, ray_tpu.exceptions.GetTimeoutError)
+        # ...and the surviving rank aborts out of its 20s rendezvous
+        # deadline in well under 5s via the liveness/abort marker —
+        # typed, retryable, and carrying the fenced incarnation.
+        with pytest.raises(CollectiveAbortError) as exc1:
+            ray_tpu.get(r1, timeout=30)
+        assert exc1.value.retryable
+        assert exc1.value.group == name and exc1.value.epoch == 1
+        assert time.monotonic() - t0 < 5.0, (
+            "surviving rank burned the rendezvous deadline instead of "
+            "aborting on member death")
+
+        # the gang restarts exactly once, re-forming at epoch 2
+        deadline = time.monotonic() + 60
+        info = None
+        while time.monotonic() < deadline:
+            info = w.gcs.get_gang_info(name)
+            if info is not None and info.state == "ALIVE" \
+                    and info.epoch == 2:
+                break
+            time.sleep(0.05)
+        assert info is not None and info.state == "ALIVE", info
+        assert info.epoch == 2 and info.num_aborts == 1
+        assert info.num_restarts == 1
+        assert w.num_gang_aborts == 1 and w.num_gang_restarts == 1
+
+        # the old incarnation's artifacts were scrubbed by the restart
+        root = col.group_root(name)
+        leftovers = [p for p in os.listdir(root)
+                     if (p.startswith("ep_") or p.startswith("aborted_"))
+                     and not p.endswith("00000002")]
+        assert leftovers == [], f"stale incarnation leaked: {leftovers}"
+
+        # epoch fencing: inject a stale rank file where the OLD
+        # incarnation's next allreduce generation would have lived —
+        # without the fence this is exactly the path a resurrected
+        # epoch-1 writer (or an unfenced layout) would collide on.
+        stale_gen = os.path.join(root, "ep_00000001", "ar_00000002")
+        os.makedirs(stale_gen)
+        for r in range(2):
+            with open(os.path.join(stale_gen, f"rank_{r}.npy"), "wb") as f:
+                np.save(f, np.asarray([99.0], np.float32))
+
+        # post-restart allreduce at the new epoch: correct values (the
+        # stale 99s are provably ignored), no hang.
+        epochs = ray_tpu.get([m.group_epoch.remote() for m in ms],
+                             timeout=60)
+        assert epochs == [2, 2]
+        outs = ray_tpu.get(
+            [m.do_allreduce.remote([float(i + 1)])
+             for i, m in enumerate(ms)], timeout=30)
+        for o in outs:
+            np.testing.assert_allclose(o, [3.0])
+
+        # observability: all three gang gauges moved
+        from ray_tpu.util import metrics
+        text = metrics.prometheus_text()
+        lines = dict()
+        for line in text.splitlines():
+            if line.startswith("ray_tpu_gang"):
+                key, val = line.rsplit(" ", 1)
+                lines[key] = float(val)
+        assert lines.get("ray_tpu_gang_aborts") == 1.0
+        assert lines.get("ray_tpu_gang_restarts") == 1.0
+        assert lines.get(f'ray_tpu_gang_epoch{{group="{name}"}}') == 2.0
+    finally:
+        col.destroy_collective_group(name)
+        ray_tpu.shutdown()
+
+
+def test_gang_budget_exhausted_surfaces_actor_death():
+    """With gang_max_restarts=0 a member death kills the gang: the dead
+    member surfaces ActorDiedError to callers, survivors' collectives
+    abort, and the gang is DEAD with its epoch fenced."""
+    ray_tpu.shutdown()
+    w = ray_tpu.init(num_cpus=4, num_tpus=8, max_process_workers=1)
+    doomed, survivor = _armed_member_pair()
+    ms = [doomed, survivor]
+    name = col.create_collective_group(ms, world_size=2, ranks=[0, 1],
+                                       gang_max_restarts=0)
+    try:
+        r0 = doomed.do_allreduce.remote([1.0])
+        r1 = survivor.do_allreduce.remote([2.0])
+        with pytest.raises(Exception):
+            ray_tpu.get(r0, timeout=30)
+        with pytest.raises(CollectiveAbortError):
+            ray_tpu.get(r1, timeout=30)
+
+        deadline = time.monotonic() + 30
+        info = None
+        while time.monotonic() < deadline:
+            info = w.gcs.get_gang_info(name)
+            if info is not None and info.state == "DEAD":
+                break
+            time.sleep(0.05)
+        assert info is not None and info.state == "DEAD"
+        # no restart: the member stays dead and callers see it
+        from ray_tpu.exceptions import ActorDiedError
+        with pytest.raises(ActorDiedError):
+            ray_tpu.get(doomed.ping.remote(), timeout=30)
+    finally:
+        col.destroy_collective_group(name)
+        ray_tpu.shutdown()
 
 
 def test_xla_collectives_on_mesh():

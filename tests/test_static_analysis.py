@@ -163,6 +163,96 @@ def test_bounded_queue_accepts_annotation_block_above(tmp_path):
     assert len(hits) == 1 and hits[0].line == 4
 
 
+def test_deadline_discipline_flags_clockless_poll_loop():
+    """The fixture's `bad` loop (sleep-poll, no clock) is flagged; the
+    deadline-checking `good` loop and the `# no-deadline:` annotated
+    daemon loop are not."""
+    unsuppressed, _ = _run([_fixture("bad_deadline.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "deadline-discipline"]
+    assert len(hits) == 1
+    assert hits[0].context == "Poller.bad"
+    assert "sleep-poll" in hits[0].message
+
+
+def test_deadline_discipline_scoped_to_runtime_trees(tmp_path):
+    """Outside _private/ and collective/ (and the fixtures) the pass
+    stays quiet; inside either runtime tree it fires."""
+    src = ("import time\n"
+           "def f(flag):\n"
+           "    while not flag():\n"
+           "        time.sleep(0.01)\n")
+    mod = tmp_path / "lib.py"
+    mod.write_text(src)
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path))
+    assert [f for f in unsuppressed
+            if f.pass_id == "deadline-discipline"] == []
+    for tree in ("_private", "collective"):
+        sub = tmp_path / tree
+        sub.mkdir()
+        mod2 = sub / "lib.py"
+        mod2.write_text(src)
+        unsuppressed, _ = _run([str(mod2)], root=str(tmp_path))
+        assert len([f for f in unsuppressed
+                    if f.pass_id == "deadline-discipline"]) == 1
+
+
+def test_deadline_discipline_ignores_event_wait_loops(tmp_path):
+    """Only bare sleep polling is in scope: Event.wait(timeout) loops
+    carry their own bound, and a nested function's sleep belongs to
+    whatever scope runs it."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    mod = priv / "mod.py"
+    mod.write_text(
+        "import time\n"
+        "def f(ev, q):\n"
+        "    while not ev.is_set():\n"
+        "        ev.wait(0.1)\n"
+        "    while q:\n"
+        "        def cb():\n"
+        "            time.sleep(1)\n"
+        "        q.pop()(cb)\n")
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path))
+    assert [f for f in unsuppressed
+            if f.pass_id == "deadline-discipline"] == []
+
+
+def test_deadline_discipline_accepts_from_import_clock(tmp_path):
+    """A compliant loop written with `from time import monotonic,
+    sleep` must not be flagged: the clock check accepts the same
+    bare-name spellings the sleep check does."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    mod = priv / "mod.py"
+    mod.write_text(
+        "from time import monotonic, sleep\n"
+        "def f(flag):\n"
+        "    deadline = monotonic() + 5.0\n"
+        "    while not flag():\n"
+        "        if monotonic() > deadline:\n"
+        "            raise TimeoutError\n"
+        "        sleep(0.01)\n")
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path))
+    assert [f for f in unsuppressed
+            if f.pass_id == "deadline-discipline"] == []
+
+
+def test_retry_and_queue_passes_cover_collective_tree(tmp_path):
+    """The retry-discipline and bounded-queue scopes include
+    ray_tpu/collective/ (the gang plane is runtime core too)."""
+    coll = tmp_path / "collective"
+    coll.mkdir()
+    mod = coll / "mod.py"
+    mod.write_text(
+        "from collections import deque\n"
+        "q = deque()\n"
+        "def f(c):\n"
+        "    return c.call('x')\n")
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path))
+    ids = sorted(f.pass_id for f in unsuppressed)
+    assert "bounded-queue" in ids and "retry-discipline" in ids
+
+
 def test_clean_fixture_produces_zero_findings():
     unsuppressed, all_findings = _run([_fixture("clean.py")])
     assert all_findings == [], [f.render() for f in all_findings]
