@@ -20,7 +20,14 @@ A **rule** names an event and an action::
   worker process), ``rendezvous`` (collective-group rank-file I/O:
   ``collective.rendezvous.save_<tag>``/``load_<tag>`` with tag in
   ``ar``/``ag``/``bc``/``bar`` — ``drop`` makes a rank file vanish,
-  ``kill`` dies mid-collective).
+  ``kill`` dies mid-collective), ``checkpoint`` (the stateful
+  recovery plane: ``actor.checkpoint.save`` fires in the executor
+  mid-save with the generation staged but not yet renamed — ``kill``
+  is the canonical torn-save crash, ``drop`` makes the save vanish;
+  ``actor.checkpoint.restore`` fires per restore attempt — ``drop``
+  fails that generation so restore falls back one; and
+  ``actor.checkpoint.commit`` fires at the driver's commit site —
+  ``drop`` withholds the COMMIT marker, leaving the generation torn).
 - ``method``: the RPC method / push topic / task name at the event
   (``reply`` for reply frames; empty for lifecycle points).
 - ``action``: ``drop`` (frame vanishes), ``delay=SECONDS`` (stall),
@@ -76,7 +83,7 @@ KILL_EXIT_CODE = 42
 
 ACTIONS = ("drop", "delay", "dup", "sever", "kill", "pressure")
 POINTS = ("send", "recv", "dispatch", "spawn", "teardown", "boot",
-          "exec", "watchdog", "rendezvous", "*")
+          "exec", "watchdog", "rendezvous", "checkpoint", "*")
 
 _RULE_RE = re.compile(
     r"^(?P<component>[^.:\s]+)\.(?P<point>[^.:\s]+)\.(?P<method>[^:\s]*)"

@@ -221,6 +221,14 @@ _D("gang_reform_timeout_s", float, 60.0,
    "ALIVE again (and the re-join barrier to complete) before the gang "
    "is declared DEAD.")
 
+# --- stateful recovery (checkpointable actors; see
+# docs/fault_tolerance.md "Checkpoint semantics") ---
+_D("actor_checkpoint_keep", int, 2,
+   "Committed checkpoint generations kept per actor (a recovery "
+   "ring, not an archive): older committed generations are pruned at "
+   "commit time. At least 1; the restore path falls back one "
+   "generation per load failure within whatever is kept.")
+
 # --- chaos / fault injection (tests only; see _private/chaos.py) ---
 _D("chaos_rules", str, "",
    "Fault-injection rules (component.point.method:action[...]; "

@@ -94,6 +94,23 @@ class GangInfo:
 
 
 @dataclass
+class CheckpointInfo:
+    """One actor's newest COMMITTED checkpoint (see
+    docs/fault_tolerance.md "Checkpoint semantics"). The table records
+    only generations whose commit marker landed — a saved-but-never-
+    committed generation is invisible here by construction, so readers
+    (tests, dashboards, the gang coordinator) can treat every row as
+    restorable."""
+
+    actor_id: ActorID
+    gen: int
+    cursor: int = 0          # highest executed call seq at snapshot
+    size_bytes: int = 0
+    gang: Optional[str] = None   # committed via gang two-phase commit
+    ts: float = 0.0
+
+
+@dataclass
 class NodeInfo:
     node_id: NodeID
     resources_total: Dict[str, float] = field(default_factory=dict)
@@ -112,6 +129,8 @@ class GcsLite:
         self._actors: Dict[ActorID, ActorInfo] = {}
         self._named_actors: Dict[Tuple[str, str], ActorID] = {}
         self._gangs: Dict[str, GangInfo] = {}  # guarded-by: _lock
+        # newest committed checkpoint per actor
+        self._checkpoints: Dict[ActorID, CheckpointInfo] = {}  # guarded-by: _lock
         self._kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)
         self._job_counter = 0
 
@@ -252,6 +271,38 @@ class GcsLite:
         if g is not None:
             self.publisher.publish("GANG", ("REMOVED", name, g.epoch))
 
+    # -- actor checkpoints (committed generations only) --------------------
+
+    def record_checkpoint(self, info: CheckpointInfo) -> None:
+        """Record a COMMITTED checkpoint generation. Only the driver's
+        commit path calls this — after the commit marker is durably on
+        disk — so the table never references a torn generation. Stale
+        (out-of-order) records are ignored: commits are monotonic per
+        actor."""
+        with self._lock:
+            prev = self._checkpoints.get(info.actor_id)
+            if prev is not None and prev.gen >= info.gen:
+                return
+            self._checkpoints[info.actor_id] = info
+        self.publisher.publish("CKPT",
+                               ("COMMITTED", info.actor_id, info.gen))
+
+    def get_checkpoint(self, actor_id: ActorID
+                       ) -> Optional[CheckpointInfo]:
+        with self._lock:
+            return self._checkpoints.get(actor_id)
+
+    def list_checkpoints(self) -> List[CheckpointInfo]:
+        with self._lock:
+            return list(self._checkpoints.values())
+
+    def drop_checkpoint(self, actor_id: ActorID) -> None:
+        with self._lock:
+            info = self._checkpoints.pop(actor_id, None)
+        if info is not None:
+            self.publisher.publish("CKPT", ("DROPPED", actor_id,
+                                            info.gen))
+
     # -- internal KV (reference: InternalKVManager) ------------------------
 
     def kv_put(self, key: bytes, value: bytes, namespace: str = "") -> None:
@@ -280,6 +331,7 @@ class GcsLite:
                 "actors": self._actors,
                 "named_actors": self._named_actors,
                 "gangs": self._gangs,
+                "checkpoints": self._checkpoints,
                 "kv": dict(self._kv),
                 "job_counter": self._job_counter,
             })
@@ -292,5 +344,7 @@ class GcsLite:
             self._actors = state["actors"]
             self._named_actors = state["named_actors"]
             self._gangs = state.get("gangs", {})  # pre-gang snapshots
+            # pre-checkpoint-plane snapshots lack the table
+            self._checkpoints = state.get("checkpoints", {})
             self._kv = defaultdict(dict, state["kv"])
             self._job_counter = state["job_counter"]

@@ -18,7 +18,8 @@ import logging
 import threading
 from typing import Dict, List, Optional, Tuple
 
-from ray_tpu._private.gcs import ActorInfo, GangInfo, NodeInfo, Publisher
+from ray_tpu._private.gcs import (ActorInfo, CheckpointInfo, GangInfo,
+                                  NodeInfo, Publisher)
 from ray_tpu._private.ids import ActorID, NodeID
 from ray_tpu._private.rpc import RetryingRpcClient
 
@@ -55,7 +56,7 @@ class GcsClient:
         """Connection-scoped state, rebuilt on every (re)connect: the
         push subscriptions live server-side per connection, and any
         cached actor info may be stale across the gap."""
-        for channel in ("NODE", "ACTOR", "RESOURCES", "GANG"):
+        for channel in ("NODE", "ACTOR", "RESOURCES", "GANG", "CKPT"):
             raw.call("subscribe", channel, timeout=10.0)
         with self._cache_lock:
             self._actor_cache.clear()
@@ -163,6 +164,25 @@ class GcsClient:
 
     def unregister_gang(self, name: str) -> None:
         self._call("unregister_gang", name)
+
+    # -- actor checkpoints ---------------------------------------------
+    #
+    # Uncached like the gang table: reads sit on the restore/commit
+    # path, never the task hot path, and a stale generation read
+    # would defeat the committed-only contract.
+
+    def record_checkpoint(self, info: CheckpointInfo) -> None:
+        self._call("record_checkpoint", info)
+
+    def get_checkpoint(self, actor_id: ActorID
+                       ) -> Optional[CheckpointInfo]:
+        return self._call("get_checkpoint", actor_id)
+
+    def list_checkpoints(self) -> List[CheckpointInfo]:
+        return self._call("list_checkpoints")
+
+    def drop_checkpoint(self, actor_id: ActorID) -> None:
+        self._call("drop_checkpoint", actor_id)
 
     # -- internal KV ---------------------------------------------------
 

@@ -97,6 +97,14 @@ class GcsServer:
                    self.state.update_gang_state(name, st, cause))
         s.register("unregister_gang",
                    lambda ctx, name: self.state.unregister_gang(name))
+        s.register("record_checkpoint",
+                   lambda ctx, info: self.state.record_checkpoint(info))
+        s.register("get_checkpoint",
+                   lambda ctx, aid: self.state.get_checkpoint(aid))
+        s.register("list_checkpoints",
+                   lambda ctx: self.state.list_checkpoints())
+        s.register("drop_checkpoint",
+                   lambda ctx, aid: self.state.drop_checkpoint(aid))
         s.register("kv_put", lambda ctx, k, v, ns: self.state.kv_put(k, v, ns))
         s.register("kv_get", lambda ctx, k, ns: self.state.kv_get(k, ns))
         s.register("kv_del", lambda ctx, k, ns: self.state.kv_del(k, ns))
@@ -115,6 +123,8 @@ class GcsServer:
                                        lambda m: self._publish("ACTOR", m))
         self.state.publisher.subscribe("GANG",
                                        lambda m: self._publish("GANG", m))
+        self.state.publisher.subscribe("CKPT",
+                                       lambda m: self._publish("CKPT", m))
 
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="rtpu-gcs-health")
@@ -127,6 +137,7 @@ class GcsServer:
                            "update_actor_location",
                            "register_gang", "update_gang_state",
                            "unregister_gang",
+                           "record_checkpoint", "drop_checkpoint",
                            "kv_put", "kv_del", "next_job_id"):
                 self._wrap_dirty(method)
             self._persist_thread = threading.Thread(
@@ -166,10 +177,12 @@ class GcsServer:
 
     def _write_snapshot(self) -> None:
         try:
-            tmp = self._persist_path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(self.state.dump_state())
-            os.replace(tmp, self._persist_path)
+            # tmp + fsync + rename via the shared durable helper: a
+            # crash mid-write must leave the previous snapshot — it is
+            # the only copy a restarted GCS can come back from.
+            from ray_tpu._private import durable
+            durable.atomic_write_bytes(self._persist_path,
+                                       self.state.dump_state())
         except Exception:
             logger.exception("gcs persistence write failed")
 
@@ -348,6 +361,8 @@ def spawn_gcs_process(session: str, config_json: str = "",
         + env.get("PYTHONPATH", "").split(os.pathsep))
     env["JAX_PLATFORMS"] = "cpu"   # the GCS never touches the TPU
     env.pop("PALLAS_AXON_POOL_IPS", None)   # no chip tunnel in children
+    # non-durable-ok: append-only child log stream; a torn tail line
+    # costs log text, never state
     log = open(os.path.join(d, "gcs.log"), "ab")
     cmd = [sys.executable, "-m", "ray_tpu._private.gcs_server",
            "--port-file", port_file, "--config", config_json]

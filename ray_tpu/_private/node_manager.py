@@ -262,6 +262,11 @@ class NodeManagerGroup:
         self._running: Dict[TaskID, RunningTask] = {}  # guarded-by: _lock
         self._actor_workers: Dict[ActorID, Tuple[NodeID, BaseWorker, dict]] = {}  # guarded-by: _lock
         self._actor_death_cb: Optional[Callable] = None
+        # checkpoint plane (set by Worker): a saved-generation report
+        # from an actor's executor, and the restore info riding a
+        # (re)creation's actor_ready
+        self._actor_ckpt_cb: Optional[Callable] = None
+        self._actor_restore_cb: Optional[Callable] = None
 
         self._wake = threading.Event()
         self._shutdown = False
@@ -809,6 +814,7 @@ class NodeManagerGroup:
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             payload["actor_id"] = spec.actor_creation_id.binary()
             payload["max_concurrency"] = spec.max_concurrency
+            payload["checkpoint_interval"] = spec.checkpoint_interval
             if spec.lifetime == "detached":
                 # The raylet must keep this actor when our connection
                 # goes away (detached lifetime).
@@ -858,6 +864,10 @@ class NodeManagerGroup:
             self._remote_actor_ready(handle, payload)
         elif topic == "actor_died":
             self._remote_actor_died(handle, payload)
+        elif topic == "actor_ckpt":
+            if self._actor_ckpt_cb is not None:
+                self._actor_ckpt_cb(ActorID(payload["actor_id"]),
+                                    payload["info"])
 
     def _complete_remote_task(self, handle: RemoteNodeHandle,
                               msg: dict) -> None:
@@ -915,6 +925,9 @@ class NodeManagerGroup:
             self._free_allocation(rt.node_id, rt.resources, rt.pg)
             self._complete_task(task_id, [], err_blob, None)
         else:
+            restore = msg.get("restore")
+            if restore is not None and self._actor_restore_cb is not None:
+                self._actor_restore_cb(ActorID(actor_id_b), restore)
             self.register_actor_worker(
                 ActorID(actor_id_b), rt.node_id,
                 RemoteActorWorker(handle, actor_id_b), rt.resources,
@@ -1243,6 +1256,9 @@ class NodeManagerGroup:
             "args": payload["args"],
             "return_ids": payload["return_ids"],
         }
+        if payload.get("seq"):
+            # checkpoint cursor input: varies per call, never templated
+            out["seq"] = payload["seq"]
         if payload.get("kwargs_keys"):
             out["kwargs_keys"] = payload["kwargs_keys"]
         if payload.get("num_returns", 1) != 1:
@@ -2016,6 +2032,7 @@ class NodeManagerGroup:
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             payload["actor_id"] = spec.actor_creation_id.binary()
             payload["max_concurrency"] = spec.max_concurrency
+            payload["checkpoint_interval"] = spec.checkpoint_interval
         try:
             raylet.worker_pool.ensure_function(
                 worker, spec.function.function_id,
@@ -2116,7 +2133,8 @@ class NodeManagerGroup:
             self._complete_task(task_id, results, err_blob, None,
                                 timings)
         elif op == "actor_ready":
-            _, actor_id_b, err_blob = reply
+            _, actor_id_b, err_blob = reply[:3]
+            restore = reply[3] if len(reply) > 3 else None
             task_id = None
             with self._lock:
                 for tid, rt in self._running.items():
@@ -2138,10 +2156,20 @@ class NodeManagerGroup:
                 self._free_allocation(rt.node_id, rt.resources, rt.pg)
                 self._complete_task(task_id, [], err_blob, None)
             else:
+                if restore is not None and \
+                        self._actor_restore_cb is not None:
+                    # BEFORE completion: _on_actor_creation_done trims
+                    # the replay queue against this restore's cursor
+                    self._actor_restore_cb(ActorID(actor_id_b), restore)
                 self.register_actor_worker(
                     ActorID(actor_id_b), rt.node_id, worker, rt.resources,
                     pg=rt.pg, creation_spec=rt.spec)
                 self._complete_task(task_id, [], None, None)
+        elif op == "ckpt_saved":
+            # a checkpointable actor's executor wrote a generation;
+            # the owner decides the commit (solo: now; gang: two-phase)
+            if self._actor_ckpt_cb is not None:
+                self._actor_ckpt_cb(ActorID(reply[1]), reply[2])
 
     def _io_loop(self) -> None:
         from multiprocessing.connection import wait as conn_wait

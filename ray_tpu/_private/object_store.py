@@ -292,6 +292,10 @@ class ShmStore:
         size = self._sizes.pop(object_id)
         self._sealed.pop(object_id)
         path = self._spill_path(object_id)
+        # non-durable-ok: a torn spill file reads back as a lost
+        # object, which lineage reconstruction recovers (tier-1
+        # test_reconstruct_lost_spill_file); fsync here would sit on
+        # the store's eviction path
         with open(path, "wb") as f:
             f.write(seg.buf[:size])
         seg.unlink()

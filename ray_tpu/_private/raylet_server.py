@@ -808,8 +808,19 @@ class RayletServer:
                 "error_blob": err_blob, "system_error": None,
                 "timings": timings},
                 ctx=self._ctx_for_task(task_id, pop=True))
+        elif op == "ckpt_saved":
+            # relay a saved checkpoint generation to the owner (the
+            # commit decision lives driver-side; ordering after this
+            # actor's task_done pushes holds — same channel)
+            _, actor_id, info = reply
+            with self._lock:
+                ckpt_ctx = self._actor_ctx.get(actor_id)
+            self._push_owner("actor_ckpt",
+                             {"actor_id": actor_id, "info": info},
+                             ctx=ckpt_ctx)
         elif op == "actor_ready":
-            _, actor_id, err_blob = reply
+            _, actor_id, err_blob = reply[:3]
+            restore = reply[3] if len(reply) > 3 else None
             with self._lock:
                 tid = self._creation_tasks.pop(actor_id, None)
                 demand = {}
@@ -836,7 +847,8 @@ class RayletServer:
                 if orphaned:
                     return   # nobody left to tell
             self._push_owner("actor_ready", {
-                "actor_id": actor_id, "error_blob": err_blob},
+                "actor_id": actor_id, "error_blob": err_blob,
+                "restore": restore},
                 ctx=(self._ctx_for_task(tid, pop=True)
                      if tid is not None else creation_ctx))
 
@@ -1223,6 +1235,8 @@ def spawn_raylet_process(session: str, node_id: NodeID,
            "--config", get_config().serialize()]
     if gcs_addr is not None:
         cmd += ["--gcs", f"{gcs_addr[0]}:{gcs_addr[1]}"]
+    # non-durable-ok: append-only child log stream; a torn tail line
+    # costs log text, never state
     log = open(os.path.join(d, f"raylet_{node_id.hex()[:12]}.log"), "ab")
     proc = subprocess.Popen(cmd, env=env, start_new_session=True,
                             stdout=log, stderr=log)

@@ -52,6 +52,18 @@ def install_runtime_metrics() -> None:
         "ray_tpu_gang_epoch",
         "Current incarnation epoch per collective gang",
         tag_keys=("group",))
+    checkpoints = m.Gauge(
+        "ray_tpu_checkpoints",
+        "Actor checkpoint plane: committed generations (saved), "
+        "successful restore-at-creation events (restored), and "
+        "torn/uncommitted/partial generations dropped (discarded)",
+        tag_keys=("state",))
+    ckpt_bytes = m.Gauge(
+        "ray_tpu_checkpoint_bytes",
+        "Cumulative payload bytes across committed actor checkpoints")
+    restore_ms = m.Gauge(
+        "ray_tpu_restore_ms",
+        "Duration of the most recent successful checkpoint restore")
 
     def collect():
         from ray_tpu._private.worker import try_global_worker
@@ -93,5 +105,13 @@ def install_runtime_metrics() -> None:
         gang_epoch.clear()   # destroyed gangs' series must vanish
         for g in w.gcs.list_gangs():
             gang_epoch.set(g.epoch, tags={"group": g.name})
+        checkpoints.set(getattr(w, "num_ckpt_saved", 0),
+                        tags={"state": "saved"})
+        checkpoints.set(getattr(w, "num_ckpt_restored", 0),
+                        tags={"state": "restored"})
+        checkpoints.set(getattr(w, "num_ckpt_discarded", 0),
+                        tags={"state": "discarded"})
+        ckpt_bytes.set(getattr(w, "ckpt_bytes_total", 0))
+        restore_ms.set(getattr(w, "last_restore_ms", 0.0))
 
     m.register_collector(collect)

@@ -92,6 +92,10 @@ class TaskOptions:
     lifetime: Optional[str] = None
     namespace: Optional[str] = None
     get_if_exists: bool = False
+    # Checkpointable actors (__ray_save__/__ray_restore__): runtime-
+    # driven snapshot every N completed calls; 0 disables autosave
+    # (restore-at-creation still applies when checkpoints exist).
+    checkpoint_interval: int = 0
 
     def resource_demand(self, default_cpus: float = 1.0) -> Dict[str, float]:
         demand: Dict[str, float] = {}
@@ -134,6 +138,7 @@ class TaskSpec:
     max_restarts: int = 0
     max_task_retries: int = 0
     max_concurrency: int = 1
+    checkpoint_interval: int = 0     # actors: autosave every N calls
     lifetime: Optional[str] = None   # None | "detached"
     name: str = ""
     runtime_env: Optional[dict] = None

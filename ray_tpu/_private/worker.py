@@ -297,6 +297,22 @@ class Worker:
         self._actor_gang: Dict[ActorID, str] = {}  # guarded-by: _gang_lock
         self.num_gang_aborts = 0
         self.num_gang_restarts = 0
+        # stateful recovery plane (docs/fault_tolerance.md "Checkpoint
+        # semantics"): restore info riding each (re)creation, staged
+        # gang generations awaiting the two-phase commit, and the
+        # checkpoint gauges' counters
+        self._pending_restore: Dict[ActorID, dict] = {}  # guarded-by: _actor_lock
+        # gang -> gen -> {actor_id: saved-info}; partial generations
+        # are discarded on gang abort/restart
+        self._gang_ckpt_stage: Dict[str, Dict[int, Dict[ActorID, dict]]] \
+            = {}  # guarded-by: _gang_lock
+        self.num_ckpt_saved = 0       # committed generations (per actor)
+        self.num_ckpt_restored = 0    # successful restores at creation
+        self.num_ckpt_discarded = 0   # torn/uncommitted/partial drops
+        self.ckpt_bytes_total = 0     # bytes across committed saves
+        self.last_restore_ms = 0.0
+        self.node_group._actor_ckpt_cb = self._on_actor_ckpt_saved
+        self.node_group._actor_restore_cb = self._on_actor_restore_info
         self._actor_flush_wake = threading.Event()
         self._actor_flusher = threading.Thread(
             target=self._actor_flush_loop, daemon=True,
@@ -1408,6 +1424,7 @@ class Worker:
             max_restarts=max_restarts,
             max_task_retries=options.max_task_retries,
             max_concurrency=max(1, options.max_concurrency),
+            checkpoint_interval=max(0, options.checkpoint_interval),
             lifetime=options.lifetime,
             scheduling_strategy=options.scheduling_strategy,
             name=options.name or class_name,
@@ -1443,6 +1460,8 @@ class Worker:
     def _on_actor_creation_done(self, spec: TaskSpec, err_blob,
                                 system_error) -> None:
         actor_id = spec.actor_creation_id
+        with self._actor_lock:
+            restore = self._pending_restore.pop(actor_id, None)
         if err_blob is None and system_error is None:
             with self._actor_lock:
                 tombstoned = actor_id in self._actor_tombstones
@@ -1461,6 +1480,11 @@ class Worker:
                 node_id = self.node_group.actor_node(actor_id)
                 if node_id is not None:
                     self.gcs.update_actor_location(actor_id, node_id)
+            if restore:
+                # Restore-before-replay: trim BEFORE the actor turns
+                # ALIVE — the flusher only drains ALIVE actors, so a
+                # pre-checkpoint call can never ship before the trim.
+                self._apply_restore_info(actor_id, restore)
             self.gcs.update_actor_state(actor_id, "ALIVE")
             from ray_tpu._private import export
             export.emit("ACTOR", {"actor_id": actor_id.hex(),
@@ -1474,6 +1498,7 @@ class Worker:
                                   "state": "DEAD",
                                   "cause": "creation failed"})
             self._fail_actor_queue(actor_id, err_blob)
+            self._cleanup_actor_ckpt(actor_id)
 
     def _ensure_actor_route(self, actor_id: ActorID, info) -> None:
         """Make a detached actor created by ANOTHER driver callable
@@ -1705,6 +1730,10 @@ class Worker:
             "type": "exec_actor",
             "task_id": spec.task_id.binary(),
             "actor_id": spec.actor_id.binary(),
+            # per-caller submission sequence: the checkpoint cursor
+            # records the highest executed seq, so post-restore replay
+            # can be trimmed to calls after the snapshot
+            "seq": spec.sequence_number,
             "method": getattr(spec, "method_name", ""),
             "function_id": spec.function.function_id,
             "args": arg_descs,
@@ -1723,6 +1752,187 @@ class Worker:
     def _task_cancelled(self, task_id: TaskID) -> bool:
         rec = self.task_manager.get_record(task_id)
         return rec is not None and rec.cancelled
+
+    # -- actor checkpoints (stateful recovery plane; see
+    # docs/fault_tolerance.md "Checkpoint semantics") --------------------
+
+    def _on_actor_restore_info(self, actor_id: ActorID,
+                               info: dict) -> None:
+        """actor_ready carried restore info: park it for the creation
+        task's completion hook (which runs the replay trim)."""
+        with self._actor_lock:
+            self._pending_restore[actor_id] = dict(info)
+
+    def _apply_restore_info(self, actor_id: ActorID, info: dict) -> None:
+        """A (re)created actor restored generation ``restored_gen`` at
+        replay cursor ``cursor``: account the gauges and trim queued
+        replay to calls AFTER the cursor — the restored state already
+        includes every call at or below it, so re-executing one would
+        double-apply its side effects. Trimmed calls' (lost) results
+        surface as errors; in practice the save path sends results
+        before the covering checkpoint on the same FIFO channel, so a
+        call can only be trimmed when its completion already landed."""
+        if int(info.get("restored_gen") or 0) > 0:
+            self.num_ckpt_restored += 1
+            self.last_restore_ms = float(info.get("restore_ms") or 0.0)
+        self.num_ckpt_discarded += int(info.get("discarded") or 0)
+        cursor = int(info.get("cursor") or 0)
+        if cursor <= 0:
+            return
+        trimmed: List[TaskSpec] = []
+        with self._actor_lock:
+            q = self._actor_queues.get(actor_id)
+            if q:
+                for s in list(q):
+                    # seq 0 = gang re-join specs (front-loaded by the
+                    # restart coordinator): never trimmed
+                    if 0 < s.sequence_number <= cursor:
+                        q.remove(s)
+                        trimmed.append(s)
+        for s in trimmed:
+            self._fail_task(s, RuntimeError(
+                f"actor call {s.repr_name()} (seq {s.sequence_number}) "
+                f"executed before the restored checkpoint (cursor "
+                f"{cursor}); its side effects are part of the restored "
+                "state, so the replay was trimmed instead of "
+                "double-executing it"))
+
+    def _on_actor_ckpt_saved(self, actor_id: ActorID, info: dict) -> None:
+        """An executor reported a durably-saved (but uncommitted)
+        generation. Solo actors commit immediately; gang members stage
+        until EVERY rank has reported the same generation (two-phase
+        commit over the gang table) — a mid-checkpoint kill leaves a
+        partial stage that is discarded, never a torn restore."""
+        gen = int(info.get("gen") or 0)
+        with self._gang_lock:
+            name = self._actor_gang.get(actor_id)
+            rec = self._gangs.get(name) if name is not None else None
+            if rec is not None:
+                if rec.restarting or rec.dead:
+                    # a report from the aborted incarnation (possibly
+                    # a PR-2 push replay): staging it would collide
+                    # with post-restart generation numbers — the
+                    # restore resets each rank's counter to its
+                    # committed max, so reused gens must start clean
+                    self.num_ckpt_discarded += 1
+                    return
+                stage = self._gang_ckpt_stage.setdefault(name, {})
+                stage.setdefault(gen, {})[actor_id] = dict(info)
+                per_gen = stage[gen]
+                if any(aid not in per_gen for aid in rec.actor_ids):
+                    return          # first phase: wait for the rest
+                items = [(aid, per_gen[aid]) for aid in rec.actor_ids]
+                # second phase reached: drop this and every OLDER
+                # staged generation (superseded partials can never
+                # complete once the gang moved past them)
+                for g in [g for g in stage if g <= gen]:
+                    if g != gen:
+                        self.num_ckpt_discarded += len(stage[g])
+                    del stage[g]
+            else:
+                items = [(actor_id, dict(info))]
+        self._commit_actor_ckpt(items, gang=name if rec else None)
+
+    def _commit_actor_ckpt(self, items, gang: Optional[str]) -> None:
+        """Write COMMIT markers + record the generation in the GCS
+        checkpoint table. Runs outside the gang lock (file IO + GCS
+        RPC must not gate the actor flusher).
+
+        Gang commits are ALL-OR-NOTHING: if any rank's marker write
+        fails, markers already written this pass are rolled back so no
+        restore can ever see a generation committed on some ranks and
+        not others (the torn-restore case the two-phase design
+        exists to rule out)."""
+        import json as _json
+        from ray_tpu._private import actor_checkpoint as _ackpt
+        from ray_tpu._private import chaos, durable
+        from ray_tpu._private.gcs import CheckpointInfo
+        if chaos.fire("actor", "checkpoint", "commit") == "drop":
+            # commit marker never lands: the saved generation stays
+            # uncommitted and restore provably discards it
+            self.num_ckpt_discarded += len(items)
+            return
+        written: List[str] = []
+        committed = []
+        for aid, info in items:
+            gen = int(info.get("gen") or 0)
+            root = _ackpt.actor_ckpt_dir(self.session, aid.binary())
+            marker = _ackpt.commit_marker_path(root, gen)
+            try:
+                # never commit a generation whose payload is gone (a
+                # concurrent restart's discard may have reaped it):
+                # the marker write would fabricate an empty
+                # "committed" dir via makedirs
+                if not os.path.isfile(os.path.join(
+                        os.path.dirname(marker), "state.pkl")):
+                    raise FileNotFoundError(
+                        f"generation payload missing under "
+                        f"{os.path.dirname(marker)}")
+                durable.atomic_write_bytes(
+                    marker,
+                    _json.dumps({"gen": gen, "gang": gang,
+                                 "ts": time.time()}).encode())
+                written.append(marker)
+            except Exception:
+                logger.exception("checkpoint commit failed for %s "
+                                 "gen %d", aid.hex()[:8], gen)
+                if gang is not None:
+                    # roll the whole gang generation back: a partially
+                    # committed generation must not exist
+                    for m in written:
+                        try:
+                            os.unlink(m)
+                        except OSError:
+                            pass    # rollback is best-effort; restore
+                                    # tolerates a marker-only dir too
+                    self.num_ckpt_discarded += len(items)
+                    return
+                self.num_ckpt_discarded += 1
+                continue
+            committed.append((aid, info, gen, root))
+        for aid, info, gen, root in committed:
+            try:
+                _ackpt.prune_generations(
+                    root, get_config().actor_checkpoint_keep)
+            except Exception:
+                logger.exception("checkpoint prune failed")
+            self.num_ckpt_saved += 1
+            self.ckpt_bytes_total += int(info.get("bytes") or 0)
+            try:
+                self.gcs.record_checkpoint(CheckpointInfo(
+                    actor_id=aid, gen=gen,
+                    cursor=int(info.get("cursor") or 0),
+                    size_bytes=int(info.get("bytes") or 0),
+                    gang=gang, ts=time.time()))
+            except Exception:
+                # table record is observability; the durable commit
+                # marker is the restore authority and already landed
+                logger.exception("checkpoint table record failed")
+
+    def _cleanup_actor_ckpt(self, actor_id: ActorID) -> None:
+        """A permanently-DEAD actor can never restore: remove its
+        on-disk generations and drop its GCS checkpoint row (mirrors
+        destroy_collective_group's rmtree + unregister cleanup). No-op
+        for actors that never checkpointed."""
+        import shutil as _shutil
+        from ray_tpu._private import actor_checkpoint as _ackpt
+        root = _ackpt.actor_ckpt_dir(self.session, actor_id.binary())
+        if not os.path.isdir(root):
+            return
+        _shutil.rmtree(root, ignore_errors=True)
+        try:
+            self.gcs.drop_checkpoint(actor_id)
+        except Exception:
+            logger.exception("checkpoint table drop failed")
+
+    def _discard_gang_ckpt_stage(self, name: str) -> None:
+        """Gang aborted/restarting/dead: every partially-staged
+        generation is torn by definition — discard."""
+        with self._gang_lock:
+            stage = self._gang_ckpt_stage.pop(name, None)
+        if stage:
+            self.num_ckpt_discarded += sum(
+                len(per_gen) for per_gen in stage.values())
 
     # -- collective gangs (coordinated SPMD restart) ---------------------
 
@@ -1762,6 +1972,7 @@ class Worker:
                     if self._actor_gang.get(aid) == name:
                         self._actor_gang.pop(aid, None)
         if rec is not None:
+            self._discard_gang_ckpt_stage(name)
             self.gcs.unregister_gang(name)
 
     def _gang_flush_gated(self, actor_id: ActorID) -> bool:
@@ -1816,6 +2027,11 @@ class Worker:
                 self.node_group.submit_task(creation)
             return True
         root = _col.group_root(name)
+        # either way this incarnation is over: partially-staged
+        # checkpoint generations can never complete — discard them
+        # (committed generations are untouched; they are the restore
+        # points the coordinated restart comes back from)
+        self._discard_gang_ckpt_stage(name)
         if mode == "dead":
             # budget exhausted, gang already dead, or the user killed a
             # member: no (further) restart. Callers see ActorDiedError
@@ -1835,6 +2051,7 @@ class Worker:
                 self.gcs.update_gang_state(name, "DEAD",
                                            death_cause=cause)
             self._fail_actor_queue(actor_id, None)
+            self._cleanup_actor_ckpt(actor_id)
             return True
         # abort this incarnation and restart the whole gang. rec's
         # epoch/restarting/gated fields now have a single writer (this
@@ -2000,6 +2217,7 @@ class Worker:
                                   "state": "DEAD",
                                   "cause": "worker died"})
             self._fail_actor_queue(actor_id, None)
+            self._cleanup_actor_ckpt(actor_id)
 
     def _fail_actor_queue(self, actor_id: ActorID,
                           err_blob: Optional[bytes]) -> None:
@@ -2037,6 +2255,7 @@ class Worker:
         export.emit("ACTOR", {"actor_id": actor_id.hex(),
                               "state": "DEAD", "cause": "killed"})
         self._fail_actor_queue(actor_id, None)
+        self._cleanup_actor_ckpt(actor_id)
         # A killed gang member takes its gang down: fence the epoch and
         # fan CollectiveAbortError out to any in-op ranks (the user
         # chose to kill; the gang does not restart over it).

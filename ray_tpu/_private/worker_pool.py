@@ -108,6 +108,8 @@ class ProcessWorker(BaseWorker):
         # RPC tails these files to the driver.
         from ray_tpu._private.log_monitor import worker_log_path
         self.log_path = worker_log_path(session, self.worker_id.hex())
+        # non-durable-ok: append-only worker log stream; a torn tail
+        # line costs log text, never state
         log = open(self.log_path, "ab", buffering=0)
         try:
             self.proc = subprocess.Popen(

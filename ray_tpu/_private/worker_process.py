@@ -47,11 +47,14 @@ from __future__ import annotations
 
 import contextvars
 import inspect
+import logging
 import os
 import threading
 import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ObjectID, TaskID
@@ -126,6 +129,12 @@ class ExecutionEnv:
         self._pools: Dict[bytes, Any] = {}
         # actor_id -> _AsyncActorLoop (actors with async def methods)
         self._aloops: Dict[bytes, "_AsyncActorLoop"] = {}
+        # checkpointable SERIAL actors: autosave bookkeeping per actor
+        # ({root, interval, count, gen, cursor}; see _private/
+        # actor_checkpoint.py). Pooled/async actors restore at creation
+        # but never autosave — concurrent in-flight calls make "state
+        # after N calls" ill-defined there.
+        self._actor_ckpt: Dict[bytes, dict] = {}
         self.shm_client = ShmClient(session)
         self.serde = serialization.get_context()
         self.current_task_name = ""
@@ -196,6 +205,10 @@ class ExecutionEnv:
             # loop, whose event-loop iterations make it safe.
             for p in payloads:
                 send(self.execute(p, emit=send))
+                # AFTER the reply ships: the owner must process a
+                # call's completion before the checkpoint that covers
+                # it (FIFO pipe => a commit never outruns its results)
+                self._maybe_autosave(p.get("actor_id"), send)
             return
         payload = self.merge_stage(self.merge_actor(body))
         if op == "exec_actor":
@@ -211,6 +224,58 @@ class ExecutionEnv:
                                                                 emit=send)))
                 return
         send(self.execute(payload, emit=send))
+        if op == "exec_actor":
+            self._maybe_autosave(payload.get("actor_id"), send)
+
+    # -- actor checkpoints (docs/fault_tolerance.md "Checkpoint
+    # semantics"): runtime-driven __ray_save__ snapshots ----------------
+
+    def _maybe_autosave(self, actor_id, send) -> None:
+        if not self._actor_ckpt:     # hot-path guard: no
+            return                   # checkpointable actors here
+        rec = self._actor_ckpt.get(actor_id)
+        if (rec is None or rec["interval"] <= 0
+                or rec["count"] < rec["interval"]):
+            return
+        self.save_actor_checkpoint(actor_id, send)
+
+    def save_actor_checkpoint(self, actor_id: bytes, send) -> bool:
+        """Snapshot one checkpointable actor: ``__ray_save__()`` ->
+        crash-atomic generation dir -> ``ckpt_saved`` notification to
+        the owner (which writes the COMMIT marker — immediately for a
+        solo actor, after every rank reports for a gang). Runs AFTER
+        the triggering call's reply was sent. A failed snapshot is
+        logged and skipped: the previous committed generation stays
+        the restore point, and the interval counter resets so a
+        persistently-failing __ray_save__ can't hot-loop."""
+        rec = self._actor_ckpt.get(actor_id)
+        instance = self.actors.get(actor_id)
+        if rec is None or instance is None:
+            return False
+        from ray_tpu._private import actor_checkpoint as _ackpt
+        rec["count"] = 0
+        gen = rec["gen"] + 1
+        try:
+            state = instance.__ray_save__()
+            nbytes = _ackpt.save_generation(rec["root"], gen,
+                                            rec["cursor"], state)
+        except BaseException:  # noqa: BLE001 — user __ray_save__ code
+            logger.exception("checkpoint save failed for actor %s "
+                             "(gen %d); previous generation stands",
+                             actor_id.hex()[:8], gen)
+            return False
+        rec["gen"] = gen
+        if nbytes <= 0:
+            return False      # chaos-dropped save: nothing to commit
+        try:
+            send(("ckpt_saved", actor_id,
+                  {"gen": gen, "cursor": rec["cursor"],
+                   "bytes": nbytes}))
+        except Exception:
+            # owner pipe gone: the generation sits uncommitted and a
+            # restore will discard it — correct either way
+            return False
+        return True
 
     def cancel_actor_task(self, actor_id: bytes, task_id: bytes) -> None:
         """Cancel an in-flight ASYNC actor call; a no-op for sync
@@ -394,18 +459,44 @@ class ExecutionEnv:
                 if payload["type"] == "create_actor":
                     instance = fn(*args, **kwargs)
                     aid = payload["actor_id"]
+                    # Restore-before-replay: a checkpointable actor
+                    # (re)starting loads its newest COMMITTED snapshot
+                    # HERE — after __init__, before any queued call can
+                    # reach it (the owner flushes only once actor_ready
+                    # lands). Restore failure falls back one committed
+                    # generation; exhausting them fails the creation.
+                    restore_info = None
+                    is_async = _has_async_methods(instance)
+                    from ray_tpu._private import (
+                        actor_checkpoint as _ackpt)
+                    if _ackpt.is_checkpointable(instance):
+                        root = _ackpt.actor_ckpt_dir(self.session, aid)
+                        restore_info = _ackpt.restore_instance(
+                            root, instance)
+                        if payload.get("max_concurrency", 1) <= 1 \
+                                and not is_async:
+                            gens = _ackpt.list_generations(root)
+                            self._actor_ckpt[aid] = {
+                                "root": root,
+                                "interval": payload.get(
+                                    "checkpoint_interval", 0),
+                                "count": 0,
+                                "gen": max((g for g, _ok in gens),
+                                           default=0),
+                                "cursor": restore_info["cursor"],
+                            }
                     self.actors[aid] = instance
                     # actors keep their runtime_env for their lifetime
                     self._actor_envs[aid] = payload.get("runtime_env")
                     conc = payload.get("max_concurrency", 1)
                     self._actor_conc[aid] = conc
-                    if _has_async_methods(instance):
+                    if is_async:
                         # async actor: a dedicated event loop executes
                         # every call; max_concurrency caps in-flight
                         # coroutines (reference async-actor semantics).
                         self._aloops[aid] = _AsyncActorLoop(
                             self, aid, max(1, conc))
-                    return ("actor_ready", aid, None)
+                    return ("actor_ready", aid, None, restore_info)
                 if payload["type"] == "exec_actor":
                     instance = self.actors[payload["actor_id"]]
                     method = getattr(instance, payload["method"])
@@ -469,10 +560,21 @@ class ExecutionEnv:
             except Exception:
                 pass    # drain is itself best-effort leak hygiene
             if payload["type"] == "create_actor":
-                return ("actor_ready", payload["actor_id"], blob)
+                return ("actor_ready", payload["actor_id"], blob, None)
             return ("done", task_id, [], blob,
                     {"exec_ms": 1e3 * (_time.perf_counter() - t_start)})
         finally:
+            # empty-dict guard first: workers without checkpointable
+            # actors must pay ~nothing here (dispatch hot path)
+            if self._actor_ckpt and payload.get("type") == "exec_actor":
+                # Advance the checkpoint cursor/interval for the call
+                # that just ran (success or user error — either way it
+                # will never be replayed, so the snapshot may cover it).
+                rec = self._actor_ckpt.get(payload.get("actor_id"))
+                if rec is not None:
+                    rec["cursor"] = max(rec["cursor"],
+                                        int(payload.get("seq") or 0))
+                    rec["count"] += 1
             # Clear identity the moment user code is done — BEFORE the
             # reply is sent — so a targeted cancel SIGINT landing in
             # the send window can't match this finished task and kill

@@ -17,6 +17,7 @@ _ACTOR_OPTION_KEYS = {
     "max_restarts", "max_task_retries", "max_concurrency", "name",
     "namespace", "lifetime", "scheduling_strategy", "runtime_env",
     "get_if_exists", "placement_group", "placement_group_bundle_index",
+    "checkpoint_interval",
 }
 
 

@@ -40,7 +40,6 @@ import json
 import logging
 import os
 import shutil
-import tempfile
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -100,17 +99,14 @@ def write_group_state(root: str, epoch: int, world_size: int,
     """Atomically publish the group's current incarnation. The driver
     writes this before every (re-)join; members read their epoch from
     it in ``init_collective_group``."""
+    from ray_tpu._private import durable
     os.makedirs(root, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=root, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump({"epoch": int(epoch), "world_size": int(world_size),
-                       "state": state}, f)
-        os.rename(tmp, _state_path(root))
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    durable.atomic_write(
+        _state_path(root),
+        lambda f: json.dump({"epoch": int(epoch),
+                             "world_size": int(world_size),
+                             "state": state}, f),
+        mode="w")
 
 
 def read_group_state(root: str) -> Optional[dict]:
@@ -125,16 +121,10 @@ def write_abort_marker(root: str, epoch: int, reason: str = "") -> None:
     """Fan an abort out to every rank in-op at ``epoch``: the marker is
     checked on every ``_wait_load`` poll, so blocked ranks raise
     ``CollectiveAbortError`` within milliseconds."""
+    from ray_tpu._private import durable
     os.makedirs(root, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=root, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            f.write(reason)
-        os.rename(tmp, _abort_marker(root, epoch))
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    durable.atomic_write(_abort_marker(root, epoch),
+                         lambda f: f.write(reason), mode="w")
 
 
 def cleanup_stale_epochs(root: str, current_epoch: int) -> None:
@@ -179,15 +169,15 @@ _groups: Dict[str, _Group] = {}
 
 
 def _atomic_save(path: str, arr: np.ndarray) -> None:
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            np.save(f, arr, allow_pickle=False)
-        os.rename(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    # Shared helper, rename-only (fsync=False): a reader polling for
+    # the rank file can never observe a torn array, but rank files are
+    # transient rendezvous artifacts on the collective HOT PATH — a
+    # crash aborts the op via the liveness/abort-marker plane, so
+    # paying two fsyncs per rank per op would buy nothing.
+    from ray_tpu._private import durable
+    durable.atomic_write(path, lambda f: np.save(f, arr,
+                                                 allow_pickle=False),
+                         fsync=False)
 
 
 def _check_abort(g: _Group) -> None:

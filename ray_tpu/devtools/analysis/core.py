@@ -79,6 +79,29 @@ class Finding:
 _COMMENT_RE = re.compile(r"#.*$")
 
 
+def suppressed_by_mark(ctx: "FileContext", node: ast.AST,
+                       mark: str) -> bool:
+    """Shared suppression contract (bounded-queue / durable-write):
+    the ``mark`` comment suppresses when it sits on any of the node's
+    own lines, or in the contiguous COMMENT-ONLY block directly above
+    it. A code line with a trailing comment ends the block — walking
+    through it would let one annotation suppress unrelated findings
+    further down."""
+    end = getattr(node, "end_lineno", node.lineno)
+    for line in range(node.lineno, end + 1):
+        comment = ctx.comments.get(line)
+        if comment and mark in comment:
+            return True
+    line = node.lineno - 1
+    while line > 0 and line in ctx.comments:
+        if not ctx.lines[line - 1].lstrip().startswith("#"):
+            break
+        if mark in ctx.comments[line]:
+            return True
+        line -= 1
+    return False
+
+
 def attr_tail(node: ast.AST) -> Optional[str]:
     """Final name of a Name/dotted-Attribute expression, e.g.
     ``raylet.worker_pool._lock`` -> ``_lock``; None for anything else.

@@ -10,6 +10,7 @@ def load_passes() -> List:
         async_blocking,
         bounded_queue,
         deadline_discipline,
+        durable_write,
         lock_discipline,
         ref_leak,
         retry_discipline,
@@ -18,4 +19,4 @@ def load_passes() -> List:
     )
     return [lock_discipline, async_blocking, rpc_surface,
             silent_exception, ref_leak, retry_discipline,
-            bounded_queue, deadline_discipline]
+            bounded_queue, deadline_discipline, durable_write]

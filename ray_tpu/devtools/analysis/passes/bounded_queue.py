@@ -25,10 +25,12 @@ from __future__ import annotations
 import ast
 from typing import List
 
-from ray_tpu.devtools.analysis.core import FileContext, Finding, attr_tail
+from ray_tpu.devtools.analysis.core import (FileContext, Finding,
+                                            attr_tail,
+                                            suppressed_by_mark)
 
 PASS_ID = "bounded-queue"
-VERSION = 2
+VERSION = 3
 
 _SCOPES = ("_private/", "collective/", "analysis_fixtures/")
 
@@ -44,26 +46,6 @@ _QUEUE_CTORS = {
     # annotated.
     "SimpleQueue": (None, None),
 }
-
-
-def _suppressed(ctx: FileContext, node: ast.Call) -> bool:
-    end = getattr(node, "end_lineno", node.lineno)
-    for line in range(node.lineno, end + 1):
-        comment = ctx.comments.get(line)
-        if comment and _SUPPRESS_MARK in comment:
-            return True
-    # The contiguous COMMENT-ONLY block directly above the
-    # construction. A code line with a trailing comment ends the
-    # block — walking through it would let one annotation suppress
-    # unrelated constructions further down.
-    line = node.lineno - 1
-    while line > 0 and line in ctx.comments:
-        if not ctx.lines[line - 1].lstrip().startswith("#"):
-            break
-        if _SUPPRESS_MARK in ctx.comments[line]:
-            return True
-        line -= 1
-    return False
 
 
 def _unbounded_literal(name: str, value: ast.AST) -> bool:
@@ -110,7 +92,7 @@ def check_file(ctx: FileContext) -> List[Finding]:
         bound_kw, bound_pos = _QUEUE_CTORS[name]
         if _is_bounded(name, node, bound_kw, bound_pos):
             continue
-        if _suppressed(ctx, node):
+        if suppressed_by_mark(ctx, node, _SUPPRESS_MARK):
             continue
         hint = (f"pass {bound_kw}=" if bound_kw
                 else "use a bounded queue type")

@@ -120,11 +120,11 @@ def report(metrics: Dict[str, Any],
         if os.path.abspath(checkpoint.path) != os.path.abspath(dst):
             shutil.copytree(checkpoint.path, dst, dirs_exist_ok=True)
         payload["checkpoint_path"] = dst
-    fd, tmp = tempfile.mkstemp(dir=ctx.report_dir, suffix=".tmp")
-    with os.fdopen(fd, "wb") as f:
-        pickle.dump(payload, f)
+    # crash-atomic (shared durable helper): the trainer's drain loop
+    # must never observe a torn report file under the final name
+    from ray_tpu._private import durable
     name = f"report_{ctx.rank:04d}_{ctx._report_seq:08d}.pkl"
-    os.rename(tmp, os.path.join(ctx.report_dir, name))
+    durable.atomic_pickle(os.path.join(ctx.report_dir, name), payload)
     # AFTER the report lands: an elastic re-form happens at a
     # RANK-AGREED boundary — the RESIZE file carries the target report
     # seq (stamped ahead of every rank's progress), and each rank

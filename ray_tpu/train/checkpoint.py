@@ -20,7 +20,13 @@ import numpy as np
 
 
 class Checkpoint:
-    """A directory of files produced by training."""
+    """A directory of files produced by training.
+
+    ``to_directory``/``as_directory`` hand out a COPY in a fresh temp
+    dir, never the live stored path: a consumer that mutates (or
+    deletes files from) the directory it was given must not corrupt
+    the stored checkpoint — it is the only copy recovery restores
+    from."""
 
     def __init__(self, path: str):
         self.path = os.path.abspath(path)
@@ -32,16 +38,28 @@ class Checkpoint:
     def to_directory(self, path: Optional[str] = None) -> str:
         if path is None:
             path = tempfile.mkdtemp(prefix="rtpu_ckpt_")
-        if os.path.abspath(path) != self.path:
-            shutil.copytree(self.path, path, dirs_exist_ok=True)
+        if os.path.abspath(path) == self.path:
+            raise ValueError(
+                "to_directory target is the checkpoint's own storage "
+                "directory; materialize into a different path (or pass "
+                "None for a fresh temp dir) — mutating the live copy "
+                "would corrupt the stored checkpoint")
+        shutil.copytree(self.path, path, dirs_exist_ok=True)
         return path
 
     def as_directory(self):
+        """Context manager yielding a private materialized copy,
+        removed on exit. Mutations inside the ``with`` affect only the
+        copy."""
         import contextlib
 
         @contextlib.contextmanager
         def cm():
-            yield self.path
+            path = self.to_directory()
+            try:
+                yield path
+            finally:
+                shutil.rmtree(path, ignore_errors=True)
         return cm()
 
     def __repr__(self):
@@ -49,15 +67,20 @@ class Checkpoint:
 
 
 def save_pytree(tree: Any, directory: str, name: str = "state") -> None:
-    """Write a jax/numpy pytree: leaves as npz, structure pickled."""
+    """Write a jax/numpy pytree: leaves as npz, structure pickled.
+    Both files land crash-atomically (``_private/durable``): a crash
+    mid-write leaves the previous checkpoint intact instead of tearing
+    the only copy."""
     import jax
+
+    from ray_tpu._private import durable
     os.makedirs(directory, exist_ok=True)
     leaves, treedef = jax.tree.flatten(tree)
     arrays = [np.asarray(leaf) for leaf in leaves]
-    np.savez(os.path.join(directory, f"{name}.npz"),
-             **{f"leaf_{i}": a for i, a in enumerate(arrays)})
-    with open(os.path.join(directory, f"{name}.treedef.pkl"), "wb") as f:
-        pickle.dump(treedef, f)
+    durable.atomic_savez(os.path.join(directory, f"{name}.npz"),
+                         {f"leaf_{i}": a for i, a in enumerate(arrays)})
+    durable.atomic_pickle(
+        os.path.join(directory, f"{name}.treedef.pkl"), treedef)
 
 
 def load_pytree(directory: str, name: str = "state") -> Any:

@@ -1,0 +1,35 @@
+"""Fixture: durable-write violations (raw binary writes to final
+paths) plus a suppressed one and a helper-routed one."""
+
+import pickle
+
+import numpy as np
+
+from ray_tpu._private import durable
+
+
+def bad_open(path, blob):
+    with open(path, "wb") as f:        # flagged: raw binary write
+        f.write(blob)
+
+
+def bad_pickle(path, obj):
+    with open(path, "r") as f:         # read: out of scope
+        f.read()
+    with open(path + ".txt", "w") as f:   # text write: out of scope
+        f.write("x")
+    pickle.dump(obj, open(path, "wb"))    # flagged twice: dump + open
+
+
+def bad_savez(path, arr):
+    np.savez(path, a=arr)              # flagged: in-place npz
+
+
+def ok_annotated(path, blob):
+    # non-durable-ok: append-only log stream, torn tail is harmless
+    with open(path, "ab") as f:
+        f.write(blob)
+
+
+def ok_durable(path, blob):
+    durable.atomic_write_bytes(path, blob)

@@ -95,6 +95,81 @@ def test_reconstruction_budget_exhausted(ray_start_regular):
         ray_tpu.get(ref)
 
 
+def test_reconstruction_after_actor_checkpoint_restore(tmp_path):
+    """Checkpoint x reconstruction interplay: a normal-task object
+    consumed by a checkpointable actor is lost AFTER the actor was
+    chaos-killed and restored from its checkpoint — the actor's next
+    call on that ref still triggers lineage reconstruction (the
+    restored actor changes nothing about object ownership), and the
+    max_retries budget is honored when exhausted."""
+    import ray_tpu._private.chaos as chaos
+    ray_tpu.shutdown()
+    w = ray_tpu.init(num_cpus=2, max_process_workers=2)
+    try:
+        @ray_tpu.remote
+        def make():
+            return np.arange(BIG, dtype=np.int64)
+
+        @ray_tpu.remote(max_restarts=1, max_task_retries=2,
+                        checkpoint_interval=1)
+        class Summer:
+            def __init__(self):
+                self.calls = 0
+
+            def ping(self):
+                return "up"
+
+            def use(self, arr):
+                self.calls += 1
+                return int(arr[:3].sum()), self.calls
+
+            def __ray_save__(self):
+                return {"calls": self.calls}
+
+            def __ray_restore__(self, st):
+                self.calls = st["calls"]
+
+        # Arm BEFORE any worker spawns (a pre-spawned unarmed worker
+        # would be reused for the actor): die at the 2nd `use` exec.
+        # The rule is method-specific, so a second worker picking it
+        # up is harmless — `make` never matches it.
+        os.environ[chaos.ENV_VAR] = "worker.exec.Summer.use:kill@2"
+        try:
+            a = Summer.remote()
+            assert ray_tpu.get(a.ping.remote(), timeout=60) == "up"
+        finally:
+            os.environ.pop(chaos.ENV_VAR, None)
+        data = make.remote()
+        ray_tpu.get(data)
+        assert ray_tpu.get(a.use.remote(data), timeout=60) == (3, 1)
+        # kill + checkpoint-restore cycle (the 2nd use dies at exec
+        # entry and replays after the restore)
+        assert ray_tpu.get(a.use.remote(data), timeout=120) == (3, 2)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if w.gcs.get_actor_info(a._actor_id).num_restarts == 1:
+                break
+            time.sleep(0.05)
+        assert w.gcs.get_actor_info(a._actor_id).num_restarts == 1
+        assert w.num_ckpt_restored == 1
+        # NOW lose the argument object: the restored actor's next call
+        # reconstructs it from lineage on the flush path
+        _lose(w, data)
+        assert ray_tpu.get(a.use.remote(data), timeout=60) == (3, 3)
+        assert w.task_manager.num_reconstructions == 1
+        # budget honored: a retry-less object lost after the restore
+        # surfaces ObjectLostError instead of reconstructing
+        dead_end = make.options(max_retries=0).remote()
+        ray_tpu.get(dead_end)
+        _lose(w, dead_end)
+        ref = a.use.remote(dead_end)
+        with pytest.raises(ObjectLostError):
+            ray_tpu.get(ref, timeout=60)
+        assert w.task_manager.num_reconstructions == 1
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_reconstruct_lost_spill_file():
     """A spilled object whose spill file vanished reconstructs
     transparently on get()."""

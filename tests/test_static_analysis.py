@@ -253,6 +253,63 @@ def test_retry_and_queue_passes_cover_collective_tree(tmp_path):
     assert "bounded-queue" in ids and "retry-discipline" in ids
 
 
+def test_durable_write_flags_raw_binary_writes():
+    """bad_durable.py: the raw open-wb, the pickle.dump (and the raw
+    open feeding it), and the in-place np.savez are flagged; reads,
+    text writes, the annotated append stream, and the helper-routed
+    write are not."""
+    unsuppressed, _ = _run([_fixture("bad_durable.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "durable-write"]
+    assert len(hits) == 4
+    messages = " | ".join(f.message for f in hits)
+    assert "open(..., 'wb')" in messages
+    assert "pickle.dump(...)" in messages
+    assert "np.savez(...)" in messages
+    assert {h.context for h in hits} == {"bad_open", "bad_pickle",
+                                         "bad_savez"}
+
+
+def test_durable_write_scoped_to_private_and_train(tmp_path):
+    """Outside _private/ and train/ (and the fixtures) the pass stays
+    quiet; inside either tree it fires; the durable helper module
+    itself is exempt (it IS the tmp+fsync+rename pattern)."""
+    src = "def f(path, b):\n    open(path, 'wb').write(b)\n"
+    mod = tmp_path / "lib.py"
+    mod.write_text(src)
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path))
+    assert [f for f in unsuppressed
+            if f.pass_id == "durable-write"] == []
+    for tree in ("_private", "train"):
+        sub = tmp_path / tree
+        sub.mkdir(exist_ok=True)
+        mod2 = sub / "lib.py"
+        mod2.write_text(src)
+        unsuppressed, _ = _run([str(mod2)], root=str(tmp_path))
+        assert len([f for f in unsuppressed
+                    if f.pass_id == "durable-write"]) == 1
+    exempt = tmp_path / "_private" / "durable.py"
+    exempt.write_text(src)
+    unsuppressed, _ = _run([str(exempt)], root=str(tmp_path))
+    assert [f for f in unsuppressed
+            if f.pass_id == "durable-write"] == []
+
+
+def test_durable_write_ignores_computed_modes(tmp_path):
+    """A non-literal mode can't be judged statically: out of scope
+    (the reviewer owns it), as are bare reads and text writes."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    mod = priv / "mod.py"
+    mod.write_text(
+        "def f(path, mode, b):\n"
+        "    open(path, mode).write(b)\n"
+        "    open(path).read()\n"
+        "    open(path, 'w').write('x')\n")
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path))
+    assert [f for f in unsuppressed
+            if f.pass_id == "durable-write"] == []
+
+
 def test_clean_fixture_produces_zero_findings():
     unsuppressed, all_findings = _run([_fixture("clean.py")])
     assert all_findings == [], [f.render() for f in all_findings]
