@@ -542,6 +542,74 @@ _PEAK_BF16_TFLOPS = {
 }
 
 
+def bench_multislice():
+    """Cross-slice runtime plane (docs/multislice.md): per-step time
+    of a 2-slice hierarchical-DCN trainer vs the identical single-mesh
+    (flat, no DCN tier) run, under a REALISTIC simulated DCN cost
+    model, plus the byte accounting that proves only ~1/num_slices of
+    gradient bytes cross the DCN tier. Runs on the actor/collective
+    plane — subprocess'd like the other runtime sections."""
+    out = {}
+    GRAD = 256 * 1024          # float64 elements => 2 MiB per payload
+    STEPS = 6
+
+    def init_fn():
+        return np.zeros(GRAD)
+
+    def grad_fn(state, global_rank, world, step):
+        return np.full(GRAD, float(global_rank + step))
+
+    def apply_fn(state, synced):
+        state = state + synced
+        return state, float(state[0])
+
+    def one_run(num_slices, ranks_per_slice):
+        import ray_tpu
+        from ray_tpu.train.multislice import (MultiSliceConfig,
+                                              MultiSliceTrainer)
+        # realistic DCN point: ~1 ms latency, 25 Gb/s per link
+        ray_tpu.init(num_cpus=8, max_process_workers=4,
+                     _system_config={"dcn_latency_ms": 1.0,
+                                     "dcn_gbps": 25.0})
+        try:
+            tr = MultiSliceTrainer(
+                init_fn, grad_fn, apply_fn,
+                MultiSliceConfig(num_slices=num_slices,
+                                 ranks_per_slice=ranks_per_slice,
+                                 resources_per_worker={"CPU": 1.0}))
+            tr.start()
+            tr.run(2)                      # warm the worker paths
+            t0 = time.perf_counter()
+            tr.run(STEPS)
+            dt = (time.perf_counter() - t0) / STEPS
+            stats = tr.dcn_stats()
+            tr.shutdown()
+            return dt, stats
+        finally:
+            ray_tpu.shutdown()
+
+    try:
+        flat_dt, _ = one_run(1, 4)
+        hier_dt, stats = one_run(2, 2)
+        grad_bytes = GRAD * 8
+        total_steps = 2 + STEPS
+        flat_dcn_bytes = 4 * grad_bytes * total_steps  # all ranks x DCN
+        out["multislice_step_ms"] = round(hier_dt * 1e3, 2)
+        out["singlemesh_step_ms"] = round(flat_dt * 1e3, 2)
+        out["dcn_step_overhead_pct"] = round(
+            100.0 * (hier_dt - flat_dt) / max(flat_dt, 1e-9), 1)
+        out["dcn_bytes_per_step"] = int(stats["bytes_tx"] / total_steps)
+        # hierarchical-vs-flat DCN traffic: 2 leader payloads per step
+        # against every rank's payload — the ~1/num_slices claim
+        out["dcn_bytes_fraction_vs_flat"] = round(
+            stats["bytes_tx"] / flat_dcn_bytes, 4)
+        out["dcn_collective_ms_per_step"] = round(
+            stats["ms"] / total_steps, 2)
+    except Exception as e:
+        print(f"# multislice bench failed: {e!r}", file=sys.stderr)
+    return out
+
+
 def bench_model_mfu():
     """Flagship-transformer training-step time and MFU% on the real
     chip. K steps run inside ONE jitted lax.scan (with the state
@@ -713,6 +781,7 @@ def main():
                                                 2)
     record.update(_run_section_subprocess("--e2e"))
     record.update(_run_section_subprocess("--serve"))
+    record.update(_run_section_subprocess("--multislice"))
     record.update(bench_model_mfu())
     print(json.dumps(record))
     print(f"# scheduled {n_scheduled} of {N_TASKS} pending; "
@@ -727,5 +796,7 @@ if __name__ == "__main__":
         print(json.dumps(bench_e2e_runtime()))
     elif "--serve" in sys.argv:
         print(json.dumps(bench_serve()))
+    elif "--multislice" in sys.argv:
+        print(json.dumps(bench_multislice()))
     else:
         main()

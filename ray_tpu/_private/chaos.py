@@ -27,7 +27,13 @@ A **rule** names an event and an action::
   ``actor.checkpoint.restore`` fires per restore attempt — ``drop``
   fails that generation so restore falls back one; and
   ``actor.checkpoint.commit`` fires at the driver's commit site —
-  ``drop`` withholds the COMMIT marker, leaving the generation torn).
+  ``drop`` withholds the COMMIT marker, leaving the generation torn),
+  ``dcn`` (the cross-slice tier: ``multislice.dcn.save_<tag>`` fires
+  before a leader's DCN rank-file write — ``drop`` makes it vanish so
+  peers abort via the liveness plane, ``kill`` dies mid-DCN-collective
+  — and ``multislice.dcn.load_<tag>`` fires per remote rank-file read
+  — ``drop`` declares the transfer failed: the reader writes the DCN
+  abort marker and raises typed instead of burning the timeout).
 - ``method``: the RPC method / push topic / task name at the event
   (``reply`` for reply frames; empty for lifecycle points).
 - ``action``: ``drop`` (frame vanishes), ``delay=SECONDS`` (stall),
@@ -83,7 +89,7 @@ KILL_EXIT_CODE = 42
 
 ACTIONS = ("drop", "delay", "dup", "sever", "kill", "pressure")
 POINTS = ("send", "recv", "dispatch", "spawn", "teardown", "boot",
-          "exec", "watchdog", "rendezvous", "checkpoint", "*")
+          "exec", "watchdog", "rendezvous", "checkpoint", "dcn", "*")
 
 _RULE_RE = re.compile(
     r"^(?P<component>[^.:\s]+)\.(?P<point>[^.:\s]+)\.(?P<method>[^:\s]*)"

@@ -221,6 +221,19 @@ _D("gang_reform_timeout_s", float, 60.0,
    "ALIVE again (and the re-join barrier to complete) before the gang "
    "is declared DEAD.")
 
+# --- multi-slice runtime plane (slice-gangs + DCN tier; see
+# docs/multislice.md) ---
+_D("dcn_latency_ms", float, 0.0,
+   "Simulated one-way latency of the cross-slice DCN tier, charged "
+   "once per remote rank-file read in a DCN collective "
+   "(ray_tpu/multislice/dcn.py). 0 disables the latency term — the "
+   "shared-memory transport then runs at host speed. The bench sets "
+   "realistic values to report cross-slice step overhead.")
+_D("dcn_gbps", float, 0.0,
+   "Simulated DCN per-link bandwidth in gigabits per second; the "
+   "transfer term bytes*8/(dcn_gbps*1e9) is charged per remote "
+   "rank-file read. 0 disables the bandwidth term (infinite link).")
+
 # --- stateful recovery (checkpointable actors; see
 # docs/fault_tolerance.md "Checkpoint semantics") ---
 _D("actor_checkpoint_keep", int, 2,

@@ -94,6 +94,27 @@ class GangInfo:
 
 
 @dataclass
+class SliceSetInfo:
+    """One multi-slice runtime set (gang-of-gangs; see
+    docs/multislice.md): each slice is one collective gang, the
+    per-slice leader ranks form a separate DCN-tier group. A slice
+    gang's abort fences the DCN tier — ``dcn_epoch`` bumps so the
+    restarting slice's stale DCN rank-files are structurally
+    unsatisfiable to the surviving slices — without touching any other
+    slice's gang."""
+
+    name: str
+    slice_gangs: Tuple[str, ...]   # gang name per slice (index = slice id)
+    dcn_group: str                 # leader-rank DCN collective group
+    world_size: int                # total ranks across all slices
+    dcn_epoch: int = 1
+    state: str = "FORMING"   # FORMING|ALIVE|DEGRADED|DEAD
+    # coordinated restarts per slice (index-aligned with slice_gangs)
+    slice_restarts: Tuple[int, ...] = ()
+    death_cause: str = ""
+
+
+@dataclass
 class CheckpointInfo:
     """One actor's newest COMMITTED checkpoint (see
     docs/fault_tolerance.md "Checkpoint semantics"). The table records
@@ -129,6 +150,7 @@ class GcsLite:
         self._actors: Dict[ActorID, ActorInfo] = {}
         self._named_actors: Dict[Tuple[str, str], ActorID] = {}
         self._gangs: Dict[str, GangInfo] = {}  # guarded-by: _lock
+        self._slicesets: Dict[str, SliceSetInfo] = {}  # guarded-by: _lock
         # newest committed checkpoint per actor
         self._checkpoints: Dict[ActorID, CheckpointInfo] = {}  # guarded-by: _lock
         self._kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)
@@ -271,6 +293,71 @@ class GcsLite:
         if g is not None:
             self.publisher.publish("GANG", ("REMOVED", name, g.epoch))
 
+    # -- slice sets (multi-slice runtime plane; see docs/multislice.md) ----
+
+    def register_sliceset(self, info: SliceSetInfo) -> None:
+        with self._lock:
+            if not info.slice_restarts:
+                info.slice_restarts = (0,) * len(info.slice_gangs)
+            self._slicesets[info.name] = info
+        self.publisher.publish("SLICESET",
+                               (info.state, info.name, info.dcn_epoch))
+
+    def get_sliceset_info(self, name: str) -> Optional[SliceSetInfo]:
+        with self._lock:
+            return self._slicesets.get(name)
+
+    def list_slicesets(self) -> List[SliceSetInfo]:
+        with self._lock:
+            return list(self._slicesets.values())
+
+    def update_sliceset(self, name: str, state: Optional[str] = None,
+                        dcn_epoch: Optional[int] = None,
+                        restarted_slice: Optional[int] = None,
+                        death_cause: str = "") -> None:
+        """Lifecycle transition by the driver's sliceset coordinator:
+        a slice-gang abort lands here as state=DEGRADED + a dcn_epoch
+        bump (+ that slice's restart counter); the DCN re-join flips
+        it back to ALIVE. The epoch is monotonic, and a state update
+        carrying an OLDER epoch is dropped — a rejoin's late ALIVE
+        racing a newer fence can never un-fence the tier. (An
+        epoch-less state update is trusted: only the fence path bumps
+        epochs, and it always sends its epoch.)"""
+        with self._lock:
+            ss = self._slicesets.get(name)
+            if ss is None:
+                return
+            if ss.state == "DEAD":
+                # terminal, like a DEAD gang: the fence's DEAD write
+                # carries no epoch, so without this guard a rejoin
+                # already past its own DEAD check could flip the row
+                # back ALIVE forever (the coordinator's rec.dead
+                # blocks every future fence that would correct it)
+                return
+            stale = (dcn_epoch is not None
+                     and int(dcn_epoch) < ss.dcn_epoch)
+            if state is not None and not stale:
+                ss.state = state
+            if dcn_epoch is not None:
+                ss.dcn_epoch = max(ss.dcn_epoch, int(dcn_epoch))
+            if restarted_slice is not None:
+                counts = list(ss.slice_restarts
+                              or (0,) * len(ss.slice_gangs))
+                if 0 <= restarted_slice < len(counts):
+                    counts[restarted_slice] += 1
+                ss.slice_restarts = tuple(counts)
+            if death_cause:
+                ss.death_cause = death_cause
+            payload = (ss.state, name, ss.dcn_epoch)
+        self.publisher.publish("SLICESET", payload)
+
+    def unregister_sliceset(self, name: str) -> None:
+        with self._lock:
+            ss = self._slicesets.pop(name, None)
+        if ss is not None:
+            self.publisher.publish("SLICESET",
+                                   ("REMOVED", name, ss.dcn_epoch))
+
     # -- actor checkpoints (committed generations only) --------------------
 
     def record_checkpoint(self, info: CheckpointInfo) -> None:
@@ -331,6 +418,7 @@ class GcsLite:
                 "actors": self._actors,
                 "named_actors": self._named_actors,
                 "gangs": self._gangs,
+                "slicesets": self._slicesets,
                 "checkpoints": self._checkpoints,
                 "kv": dict(self._kv),
                 "job_counter": self._job_counter,
@@ -344,6 +432,8 @@ class GcsLite:
             self._actors = state["actors"]
             self._named_actors = state["named_actors"]
             self._gangs = state.get("gangs", {})  # pre-gang snapshots
+            # pre-multislice snapshots lack the table
+            self._slicesets = state.get("slicesets", {})
             # pre-checkpoint-plane snapshots lack the table
             self._checkpoints = state.get("checkpoints", {})
             self._kv = defaultdict(dict, state["kv"])

@@ -19,7 +19,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private.gcs import (ActorInfo, CheckpointInfo, GangInfo,
-                                  NodeInfo, Publisher)
+                                  NodeInfo, Publisher, SliceSetInfo)
 from ray_tpu._private.ids import ActorID, NodeID
 from ray_tpu._private.rpc import RetryingRpcClient
 
@@ -56,7 +56,8 @@ class GcsClient:
         """Connection-scoped state, rebuilt on every (re)connect: the
         push subscriptions live server-side per connection, and any
         cached actor info may be stale across the gap."""
-        for channel in ("NODE", "ACTOR", "RESOURCES", "GANG", "CKPT"):
+        for channel in ("NODE", "ACTOR", "RESOURCES", "GANG", "SLICESET",
+                        "CKPT"):
             raw.call("subscribe", channel, timeout=10.0)
         with self._cache_lock:
             self._actor_cache.clear()
@@ -164,6 +165,31 @@ class GcsClient:
 
     def unregister_gang(self, name: str) -> None:
         self._call("unregister_gang", name)
+
+    # -- slice sets ----------------------------------------------------
+    #
+    # Uncached like the gang table: sliceset state is polled on the
+    # slice-recovery path (gang abort → DCN re-join), never on the
+    # task hot path, and a stale dcn_epoch read would defeat the fence.
+
+    def register_sliceset(self, info: SliceSetInfo) -> None:
+        self._call("register_sliceset", info)
+
+    def get_sliceset_info(self, name: str) -> Optional[SliceSetInfo]:
+        return self._call("get_sliceset_info", name)
+
+    def list_slicesets(self) -> List[SliceSetInfo]:
+        return self._call("list_slicesets")
+
+    def update_sliceset(self, name: str, state: Optional[str] = None,
+                        dcn_epoch: Optional[int] = None,
+                        restarted_slice: Optional[int] = None,
+                        death_cause: str = "") -> None:
+        self._call("update_sliceset", name, state, dcn_epoch,
+                   restarted_slice, death_cause)
+
+    def unregister_sliceset(self, name: str) -> None:
+        self._call("unregister_sliceset", name)
 
     # -- actor checkpoints ---------------------------------------------
     #

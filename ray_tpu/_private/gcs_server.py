@@ -97,6 +97,18 @@ class GcsServer:
                    self.state.update_gang_state(name, st, cause))
         s.register("unregister_gang",
                    lambda ctx, name: self.state.unregister_gang(name))
+        s.register("register_sliceset",
+                   lambda ctx, info: self.state.register_sliceset(info))
+        s.register("get_sliceset_info",
+                   lambda ctx, name: self.state.get_sliceset_info(name))
+        s.register("list_slicesets",
+                   lambda ctx: self.state.list_slicesets())
+        s.register("update_sliceset",
+                   lambda ctx, name, st, epoch, restarted, cause:
+                   self.state.update_sliceset(name, st, epoch, restarted,
+                                              cause))
+        s.register("unregister_sliceset",
+                   lambda ctx, name: self.state.unregister_sliceset(name))
         s.register("record_checkpoint",
                    lambda ctx, info: self.state.record_checkpoint(info))
         s.register("get_checkpoint",
@@ -123,6 +135,8 @@ class GcsServer:
                                        lambda m: self._publish("ACTOR", m))
         self.state.publisher.subscribe("GANG",
                                        lambda m: self._publish("GANG", m))
+        self.state.publisher.subscribe(
+            "SLICESET", lambda m: self._publish("SLICESET", m))
         self.state.publisher.subscribe("CKPT",
                                        lambda m: self._publish("CKPT", m))
 
@@ -137,6 +151,8 @@ class GcsServer:
                            "update_actor_location",
                            "register_gang", "update_gang_state",
                            "unregister_gang",
+                           "register_sliceset", "update_sliceset",
+                           "unregister_sliceset",
                            "record_checkpoint", "drop_checkpoint",
                            "kv_put", "kv_del", "next_job_id"):
                 self._wrap_dirty(method)

@@ -52,6 +52,20 @@ def install_runtime_metrics() -> None:
         "ray_tpu_gang_epoch",
         "Current incarnation epoch per collective gang",
         tag_keys=("group",))
+    dcn_bytes = m.Gauge(
+        "ray_tpu_dcn_bytes",
+        "Bytes injected into the simulated cross-slice DCN tier "
+        "(sum of leader rank-file writes across every sliceset; the "
+        "hierarchical allreduce keeps this at ~1/num_slices of what a "
+        "flat allreduce would move)")
+    dcn_ms = m.Gauge(
+        "ray_tpu_dcn_collective_ms",
+        "Cumulative wall-clock inside DCN-tier collectives (cost "
+        "model included), summed across slice leaders")
+    slice_restarts = m.Gauge(
+        "ray_tpu_slice_restarts",
+        "Coordinated whole-slice gang restarts per slice index "
+        "(summed across slicesets)", tag_keys=("slice",))
     checkpoints = m.Gauge(
         "ray_tpu_checkpoints",
         "Actor checkpoint plane: committed generations (saved), "
@@ -105,6 +119,15 @@ def install_runtime_metrics() -> None:
         gang_epoch.clear()   # destroyed gangs' series must vanish
         for g in w.gcs.list_gangs():
             gang_epoch.set(g.epoch, tags={"group": g.name})
+        dcn_bytes.set(getattr(w, "dcn_bytes_total", 0))
+        dcn_ms.set(getattr(w, "dcn_collective_ms_total", 0.0))
+        slice_restarts.clear()   # destroyed slicesets' series vanish
+        per_slice: dict = {}
+        for ss in w.gcs.list_slicesets():
+            for idx, count in enumerate(ss.slice_restarts):
+                per_slice[idx] = per_slice.get(idx, 0) + count
+        for idx, count in per_slice.items():
+            slice_restarts.set(count, tags={"slice": str(idx)})
         checkpoints.set(getattr(w, "num_ckpt_saved", 0),
                         tags={"state": "saved"})
         checkpoints.set(getattr(w, "num_ckpt_restored", 0),

@@ -125,6 +125,22 @@ class _GangRecord:
     dead: bool = False
 
 
+@dataclass
+class _SliceSetRecord:
+    """Driver-side view of one multi-slice set (gang-of-gangs; see
+    docs/multislice.md): which gangs are its slices and the DCN-tier
+    epoch the coordinator fences on a slice abort."""
+
+    name: str
+    slice_gangs: list            # gang name per slice (index = slice id)
+    dcn_group: str               # leader-rank DCN collective group
+    world_size: int
+    dcn_epoch: int = 1
+    # terminally dead (a slice gang died for good): no further DCN
+    # re-form can revive this set
+    dead: bool = False
+
+
 class Worker:
     """The driver-side core worker (single owner in the v0 slice)."""
 
@@ -297,6 +313,21 @@ class Worker:
         self._actor_gang: Dict[ActorID, str] = {}  # guarded-by: _gang_lock
         self.num_gang_aborts = 0
         self.num_gang_restarts = 0
+        # multi-slice runtime plane (docs/multislice.md): sliceset
+        # records + slice-gang -> (set, slice index) mapping, and the
+        # DCN-tier observability counters (fed by the trainer driver /
+        # SliceSet.refresh_dcn_stats pulling leader-local counters)
+        self._sliceset_lock = threading.Lock()
+        self._slicesets: Dict[str, _SliceSetRecord] = {}  # guarded-by: _sliceset_lock
+        self._gang_sliceset: Dict[str, Tuple[str, int]] = {}  # guarded-by: _sliceset_lock
+        # per-set (bytes, ms) plus the fold of retired/replaced sets;
+        # the gauges read retired + cross-set sums — cumulative, so a
+        # destroyed set's traffic stays counted and a name reuse can't
+        # walk them backwards
+        self._dcn_stats_by_set: Dict[str, Tuple[int, float]] = {}  # guarded-by: _sliceset_lock
+        self._dcn_retired: Tuple[int, float] = (0, 0.0)  # guarded-by: _sliceset_lock
+        self.dcn_bytes_total = 0
+        self.dcn_collective_ms_total = 0.0
         # stateful recovery plane (docs/fault_tolerance.md "Checkpoint
         # semantics"): restore info riding each (re)creation, staged
         # gang generations awaiting the two-phase commit, and the
@@ -1981,6 +2012,154 @@ class Worker:
             rec = self._gangs.get(name) if name is not None else None
             return rec is not None and rec.gated
 
+    # -- slice sets (multi-slice runtime plane; docs/multislice.md) ------
+
+    def register_sliceset(self, name: str, slice_gangs: list,
+                          dcn_group: str, world_size: int,
+                          dcn_epoch: int = 1) -> None:
+        """Record a gang-of-gangs (called by
+        ``multislice.SliceSet.create``): from here on, any member
+        gang's abort/death fences the DCN tier — abort marker at the
+        old DCN epoch + an epoch bump — so surviving slices' in-flight
+        DCN waits fail typed in milliseconds and the restarting
+        slice's stale DCN rank-files can never satisfy the new
+        incarnation."""
+        rec = _SliceSetRecord(name=name, slice_gangs=list(slice_gangs),
+                              dcn_group=dcn_group,
+                              world_size=world_size, dcn_epoch=dcn_epoch)
+        with self._sliceset_lock:
+            if name in self._slicesets:
+                # name reuse without a destroy: the old incarnation's
+                # DCN totals retire instead of being clobbered
+                self._retire_dcn_entry(name)
+            self._slicesets[name] = rec
+            for idx, gang in enumerate(rec.slice_gangs):
+                self._gang_sliceset[gang] = (name, idx)
+        from ray_tpu._private.gcs import SliceSetInfo
+        self.gcs.register_sliceset(SliceSetInfo(
+            name=name, slice_gangs=tuple(rec.slice_gangs),
+            dcn_group=dcn_group, world_size=world_size,
+            dcn_epoch=dcn_epoch,
+            slice_restarts=(0,) * len(rec.slice_gangs)))
+
+    def _sync_sliceset_epoch(self, name: str,
+                             dcn_epoch: Optional[int]) -> None:
+        """Fold an externally-advanced DCN epoch into the coordinator's
+        record. ``rejoin_dcn`` can re-form PAST an epoch the fence
+        never saw (a pure transport abort bumps the group state file
+        without any gang event) — a record left behind would make the
+        NEXT fence write its abort marker at a dead epoch (survivors
+        polling the live epoch would burn the group timeout) and mark
+        FORMING at the already-live one (preserving the dead
+        incarnation's rank files through cleanup)."""
+        if dcn_epoch is None:
+            return
+        with self._sliceset_lock:
+            rec = self._slicesets.get(name)
+            if rec is not None and int(dcn_epoch) > rec.dcn_epoch:
+                rec.dcn_epoch = int(dcn_epoch)
+
+    def sliceset_formed(self, name: str,
+                        dcn_epoch: Optional[int] = None) -> None:
+        """The DCN tier (re-)formed: every leader — on first creation
+        or, after a fence, restarted and surviving alike — is in the
+        group at ``dcn_epoch``. The epoch rides along so a late ALIVE
+        racing a NEWER fence is dropped by the table instead of
+        un-fencing it, and so the coordinator's own record fences the
+        LIVE epoch next time."""
+        self._sync_sliceset_epoch(name, dcn_epoch)
+        self.gcs.update_sliceset(name, state="ALIVE",
+                                 dcn_epoch=dcn_epoch)
+
+    # the post-recovery re-join publishes exactly like formation
+    sliceset_reformed = sliceset_formed
+
+    def unregister_sliceset(self, name: str) -> None:
+        with self._sliceset_lock:
+            rec = self._slicesets.pop(name, None)
+            if rec is not None:
+                for gang in rec.slice_gangs:
+                    if self._gang_sliceset.get(gang, (None,))[0] == name:
+                        self._gang_sliceset.pop(gang, None)
+                # retire the set's DCN totals: its traffic stays in
+                # the cumulative gauges, and a later set REUSING the
+                # name starts a fresh per-set entry instead of
+                # clobbering this one (gauges must never go backwards)
+                self._retire_dcn_entry(name)
+        if rec is not None:
+            self.gcs.unregister_sliceset(name)
+
+    def _retire_dcn_entry(self, name: str) -> None:  # lock-held: _sliceset_lock
+        b, m = self._dcn_stats_by_set.pop(name, (0, 0.0))
+        self._dcn_retired = (self._dcn_retired[0] + b,
+                             self._dcn_retired[1] + m)
+
+    def record_dcn_stats(self, name: str, bytes_total: int,
+                         ms_total: float) -> None:
+        """Driver-side DCN observability totals for one sliceset
+        (monotonic across leader restarts — the SliceSet accumulates
+        deltas); the gauges report retired sets' totals plus the sum
+        across every live set."""
+        with self._sliceset_lock:
+            if name not in self._slicesets:
+                # unregistered (destroyed) set: its totals were folded
+                # into the retired accumulator already — re-recording
+                # them would double-count
+                return
+            self._dcn_stats_by_set[name] = (int(bytes_total),
+                                            float(ms_total))
+            self.dcn_bytes_total = self._dcn_retired[0] + sum(
+                b for b, _ in self._dcn_stats_by_set.values())
+            self.dcn_collective_ms_total = self._dcn_retired[1] + sum(
+                m for _, m in self._dcn_stats_by_set.values())
+
+    def _fence_sliceset_dcn(self, gang_name: str,
+                            gang_dead: bool) -> None:
+        """A slice gang aborted (coordinated restart) or died: fence
+        the set's DCN tier NOW. The abort marker at the OLD epoch
+        reaches surviving leaders' in-flight DCN waits within
+        milliseconds (typed CollectiveAbortError, not the group
+        timeout); the epoch bump makes any of the dead incarnation's
+        stale DCN rank-files structurally unsatisfiable. Decision
+        under ``_sliceset_lock``; filesystem/GCS work outside it
+        (same discipline as the gang path — a stalled GCS channel
+        must not freeze callers)."""
+        with self._sliceset_lock:
+            ref = self._gang_sliceset.get(gang_name)
+            if ref is None:
+                return
+            name, slice_idx = ref
+            rec = self._slicesets.get(name)
+            if rec is None or rec.dead:
+                return
+            old_epoch = rec.dcn_epoch
+            rec.dcn_epoch += 1
+            new_epoch = rec.dcn_epoch
+            if gang_dead:
+                rec.dead = True
+        from ray_tpu import collective as _col
+        from ray_tpu._private import export
+        root = _col.group_root(rec.dcn_group)
+        cause = (f"slice {slice_idx} gang {gang_name} "
+                 + ("died" if gang_dead else
+                    f"restarting; DCN tier re-forms at epoch {new_epoch}"))
+        _col.write_abort_marker(root, old_epoch, cause)
+        if gang_dead:
+            self.gcs.update_sliceset(name, state="DEAD",
+                                     death_cause=cause)
+        else:
+            # publish the bumped epoch before anyone can re-join: the
+            # restarting slice's leader reads its DCN epoch from here
+            _col.write_group_state(root, new_epoch,
+                                   len(rec.slice_gangs), "FORMING")
+            self.gcs.update_sliceset(name, state="DEGRADED",
+                                     dcn_epoch=new_epoch,
+                                     restarted_slice=slice_idx)
+        export.emit("SLICESET", {
+            "set": name, "slice": slice_idx,
+            "state": "DEAD" if gang_dead else "DEGRADED",
+            "dcn_epoch": new_epoch})
+
     def _on_gang_member_death(self, name: str, actor_id: ActorID) -> bool:
         """Collective handling of one member's death. Returns True when
         the gang path owns the event (the individual restart path must
@@ -2050,6 +2229,9 @@ class Worker:
                 _col.write_abort_marker(root, old_epoch, cause)
                 self.gcs.update_gang_state(name, "DEAD",
                                            death_cause=cause)
+                # a dead slice takes its sliceset's DCN tier with it:
+                # surviving slices must abort typed, not hang
+                self._fence_sliceset_dcn(name, gang_dead=True)
             self._fail_actor_queue(actor_id, None)
             self._cleanup_actor_ckpt(actor_id)
             return True
@@ -2068,6 +2250,10 @@ class Worker:
             f"epoch {rec.epoch}")
         export.emit("GANG", {"group": name, "state": "ABORTED",
                              "epoch": rec.epoch})
+        # slice-gang abort fences the set's DCN tier (epoch bump +
+        # typed abort to surviving slices' in-flight DCN waits) while
+        # ONLY this slice's gang restarts below
+        self._fence_sliceset_dcn(name, gang_dead=False)
         self.task_manager.add_pending_task(creation)
         self.node_group.submit_task(creation)
         threading.Thread(
@@ -2272,6 +2458,7 @@ class Worker:
                 f"member {actor_id.hex()[:8]} killed")
             self.gcs.update_gang_state(gang_name, "DEAD",
                                        death_cause="member killed")
+            self._fence_sliceset_dcn(gang_name, gang_dead=True)
 
     # ------------------------------------------------------------------
     # lifecycle

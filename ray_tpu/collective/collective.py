@@ -377,6 +377,7 @@ def reducescatter(tensor, group_name: str = "default",
                   op: str = ReduceOp.SUM) -> np.ndarray:
     """Reduce across ranks, then scatter equal chunks along axis 0."""
     g = _get(group_name)
+    _check_abort(g)
     arr = _as_np(tensor)
     if arr.shape[0] % g.world_size != 0:
         raise ValueError(
@@ -428,7 +429,12 @@ def send(tensor, dst_rank: int, group_name: str = "default") -> None:
 
 
 def recv(src_rank: int, group_name: str = "default") -> np.ndarray:
+    """Point-to-point receive. Fails fast on an aborted epoch at ENTRY
+    like every other op: without the check a payload queued before the
+    abort would still be consumed at the fenced incarnation (the
+    in-poll marker check only covers the not-yet-arrived case)."""
     g = _get(group_name)
+    _check_abort(g)
     d = os.path.join(_epoch_dir(g.root, g.epoch),
                      f"p2p_{src_rank}_to_{g.rank}")
     os.makedirs(d, exist_ok=True)

@@ -30,9 +30,10 @@ from ray_tpu.devtools.analysis.core import (FileContext, Finding,
                                             suppressed_by_mark)
 
 PASS_ID = "bounded-queue"
-VERSION = 3
+VERSION = 4
 
-_SCOPES = ("_private/", "collective/", "analysis_fixtures/")
+_SCOPES = ("_private/", "collective/", "multislice/",
+           "analysis_fixtures/")
 
 _SUPPRESS_MARK = "unbounded-ok:"
 

@@ -33,9 +33,10 @@ from typing import List
 from ray_tpu.devtools.analysis.core import FileContext, Finding, attr_tail
 
 PASS_ID = "deadline-discipline"
-VERSION = 1
+VERSION = 2
 
-_SCOPES = ("_private/", "collective/", "analysis_fixtures/")
+_SCOPES = ("_private/", "collective/", "multislice/",
+           "analysis_fixtures/")
 
 _SUPPRESS_MARK = "no-deadline:"
 

@@ -37,9 +37,10 @@ from ray_tpu.devtools.analysis.core import (FileContext, Finding,
                                             suppressed_by_mark)
 
 PASS_ID = "durable-write"
-VERSION = 1
+VERSION = 2
 
-_SCOPES = ("_private/", "train/", "analysis_fixtures/")
+_SCOPES = ("_private/", "train/", "multislice/",
+           "analysis_fixtures/")
 _EXEMPT_FILES = ("_private/durable.py",)
 
 _SUPPRESS_MARK = "non-durable-ok:"

@@ -29,12 +29,13 @@ from typing import List
 from ray_tpu.devtools.analysis.core import FileContext, Finding
 
 PASS_ID = "retry-discipline"
-VERSION = 2
+VERSION = 3
 
 # Enforced scopes: the runtime core, the collective/gang plane, plus
 # the lint fixture tree (the self-test floor in
 # tests/analysis_fixtures/).
-_SCOPES = ("_private/", "collective/", "analysis_fixtures/")
+_SCOPES = ("_private/", "collective/", "multislice/",
+           "analysis_fixtures/")
 
 _SUPPRESS_MARK = "no-deadline:"
 

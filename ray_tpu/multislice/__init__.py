@@ -1,0 +1,31 @@
+"""Multi-slice runtime plane: slice-gangs, hierarchical DCN
+collectives, whole-slice fault recovery (docs/multislice.md).
+
+The jax-level multi-slice mesh lives in ``ray_tpu.parallel.slice_mesh``
+(device geometry: XLA routes cross-slice collectives onto DCN from the
+grid alone). THIS package is its actor/collective backend: each slice
+is a PR-4 gang, the per-slice leaders form a separate DCN-tier group
+with a simulated latency/bandwidth cost model, gradient sync is a
+hierarchical two-tier allreduce moving only ~1/num_slices of the bytes
+a flat allreduce would push across DCN, and a whole-slice failure
+recovers through gang restart + gang-consistent checkpoint restore
+while the surviving slices abort typed and wait at a fenced DCN epoch.
+"""
+
+from ray_tpu.multislice import dcn
+from ray_tpu.multislice.dcn import (
+    DcnCostModel,
+    dcn_allreduce,
+    dcn_epoch,
+    join_dcn_group,
+    reset_stats,
+    stats_snapshot,
+)
+from ray_tpu.multislice.hierarchical import hierarchical_allreduce
+from ray_tpu.multislice.slice_set import SliceSet
+
+__all__ = [
+    "DcnCostModel", "SliceSet", "dcn", "dcn_allreduce", "dcn_epoch",
+    "hierarchical_allreduce", "join_dcn_group", "reset_stats",
+    "stats_snapshot",
+]

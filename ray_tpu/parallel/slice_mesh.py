@@ -37,6 +37,7 @@ Slice membership is discovered, in order:
 from __future__ import annotations
 
 import dataclasses
+import functools as _functools
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -313,6 +314,69 @@ def make_slice_mesh(topology: SliceTopology,
     grid = np.concatenate(per_slice, axis=cross_dim)
     mesh = Mesh(grid, axis_names=_MESH_AXES)
     return SliceMesh(topology, mesh, groups)
+
+
+def broadcast_one_slice_to_all(in_tree, source_slice: int,
+                               slice_mesh: SliceMesh):
+    """Disseminate one slice's data to every slice over the cross-slice
+    (DCN) axis — the SNIPPETS.md [1] restore pattern: a checkpoint
+    read from storage by ONE slice reaches the rest through the
+    network instead of every slice re-reading storage.
+
+    Mechanics: each leaf gains a leading cross-axis dimension — the
+    source slice's slot carries the data, every other slot zeros —
+    and a jitted sum over that axis (out-sharding replicated across
+    slices) makes XLA move exactly one slice's payload per link over
+    the cross-slice tier. The stacked array is assembled shard-by-
+    shard (``make_array_from_callback``), so the host never holds an
+    S-times copy of a leaf: the zero slots come from a broadcast view
+    of a scalar, and a checkpoint-sized tree costs one transient
+    shard-sized buffer at a time, not ``num_slices x tree``. Returns
+    a pytree of global arrays replicated across slices (each leaf
+    shaped like its input).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    S = slice_mesh.num_slices
+    if not 0 <= source_slice < S:
+        raise ValueError(
+            f"source_slice {source_slice} out of range for {S} slices")
+    mesh = slice_mesh.mesh
+    cross = slice_mesh.dcn_axis
+
+    def one(x):
+        x = np.asarray(x)
+        in_sharding = NamedSharding(mesh, P(cross, *([None] * x.ndim)))
+        zeros = np.broadcast_to(np.zeros((), x.dtype), x.shape)
+
+        def shard_data(index):
+            # index is over the global (S, *x.shape); only the cross
+            # slot dimension is partitioned, inner dims are full
+            sl = index[0]
+            slots = range(sl.start or 0,
+                          S if sl.stop is None else sl.stop)
+            parts = [x if s == source_slice else zeros for s in slots]
+            return np.stack(parts)[(slice(None),) + tuple(index[1:])]
+
+        sharded = jax.make_array_from_callback(
+            (S,) + x.shape, in_sharding, shard_data)
+        out_sharding = NamedSharding(mesh, P(*([None] * x.ndim)))
+        return _sum_over_leading_axis(out_sharding)(sharded)
+
+    import jax.tree_util as jtu
+    return jtu.tree_map(one, in_tree)
+
+
+@_functools.lru_cache(maxsize=64)
+def _sum_over_leading_axis(out_sharding):
+    """One jitted sum per (mesh, rank, sharding) — a fresh lambda per
+    call would defeat jax's compile cache and pay one XLA compile per
+    leaf per broadcast."""
+    import jax
+    import jax.numpy as jnp
+    return jax.jit(lambda t: jnp.sum(t, axis=0),
+                   out_shardings=out_sharding)
 
 
 def slice_index() -> int:

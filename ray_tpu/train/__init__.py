@@ -10,6 +10,7 @@ from ray_tpu.train._session import (
     report,
 )
 from ray_tpu.train.checkpoint import Checkpoint, load_pytree, save_pytree
+from ray_tpu.train.multislice import MultiSliceConfig, MultiSliceTrainer
 from ray_tpu.train.trainer import (
     CheckpointConfig,
     DataParallelTrainer,
@@ -22,7 +23,8 @@ from ray_tpu.train.trainer import (
 
 __all__ = [
     "Checkpoint", "CheckpointConfig", "DataParallelTrainer",
-    "FailureConfig", "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
+    "FailureConfig", "JaxTrainer", "MultiSliceConfig",
+    "MultiSliceTrainer", "Result", "RunConfig", "ScalingConfig",
     "TrainContext", "get_checkpoint", "get_context", "get_dataset_shard",
     "load_pytree", "report", "save_pytree",
 ]

@@ -61,6 +61,27 @@ class ScalingConfig:
         return res
 
 
+def resources_to_actor_options(
+        res: Optional[Dict[str, float]]) -> Dict[str, Any]:
+    """Map a ``resources_per_worker`` dict onto ``.options()`` kwargs:
+    CPU/TPU/GPU/memory become their dedicated options, anything else
+    passes through as custom ``resources``. Shared by every trainer so
+    the contract stays uniform (no silently dropped keys)."""
+    res = dict(res or {})
+    kw: Dict[str, Any] = {}
+    if "CPU" in res:
+        kw["num_cpus"] = res.pop("CPU")
+    if "TPU" in res:
+        kw["num_tpus"] = res.pop("TPU")
+    if "GPU" in res:
+        kw["num_gpus"] = res.pop("GPU")
+    if "memory" in res:
+        kw["memory"] = res.pop("memory")
+    if res:
+        kw["resources"] = res
+    return kw
+
+
 @dataclasses.dataclass
 class FailureConfig:
     max_failures: int = 0
@@ -274,11 +295,7 @@ class DataParallelTrainer:
         workers = []
         seen: set = set()
         try:
-            kw: Dict[str, Any] = {}
-            if "CPU" in res:
-                kw["num_cpus"] = res["CPU"]
-            if "TPU" in res:
-                kw["num_tpus"] = res["TPU"]
+            kw = resources_to_actor_options(res)
             workers = [
                 _TrainWorker.options(
                     placement_group=pg, placement_group_bundle_index=i,

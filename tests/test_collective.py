@@ -272,6 +272,62 @@ def test_gang_budget_exhausted_surfaces_actor_death():
         ray_tpu.shutdown()
 
 
+def test_p2p_fails_fast_on_aborted_epoch_at_entry(tmp_path, monkeypatch):
+    """Entry-check audit (every public op must fail fast on a fenced
+    incarnation): a payload queued BEFORE the abort must not be
+    consumed at the aborted epoch — without recv's entry check, the
+    pre-abort send's file satisfies the poll immediately and the
+    fence never fires."""
+    monkeypatch.setenv("RAY_TPU_COLL_DIR", str(tmp_path))
+    monkeypatch.setattr(col.collective, "_BASE", str(tmp_path))
+    name = "p2p_abort_entry"
+    col.init_collective_group(1, 0, "shm", name, timeout_s=5.0)
+    try:
+        # queue a payload, THEN fence the epoch
+        col.send(np.asarray([1.0], np.float32), 0, name)
+        col.write_abort_marker(col.group_root(name), 1, "test fence")
+        t0 = time.monotonic()
+        with pytest.raises(CollectiveAbortError):
+            col.recv(0, name)
+        with pytest.raises(CollectiveAbortError):
+            col.send(np.asarray([2.0], np.float32), 0, name)
+        with pytest.raises(CollectiveAbortError):
+            col.reducescatter(np.zeros(2, np.float32), name)
+        assert time.monotonic() - t0 < 1.0, "entry checks must not poll"
+    finally:
+        col.destroy_collective_group(name)
+
+
+def test_recv_racing_gang_abort_fails_typed():
+    """Regression (point-to-point op racing a gang abort): a rank
+    blocked in recv when a peer's death fences the gang aborts typed
+    well under the group timeout — the in-poll marker check covers
+    p2p waits just like the reduction ops."""
+    ray_tpu.shutdown()
+    w = ray_tpu.init(num_cpus=4, num_tpus=8, max_process_workers=1)
+    doomed, survivor = _armed_member_pair()
+    ms = [doomed, survivor]
+    name = col.create_collective_group(ms, world_size=2, ranks=[0, 1],
+                                       gang_max_restarts=0)
+    try:
+        t0 = time.monotonic()
+        # rank 1 blocks in recv(0); rank 0 dies at its next allreduce
+        # rank-file save (the armed rule), fencing the gang
+        r_recv = survivor.do_sendrecv.remote(None, 0, False)
+        r_dead = doomed.do_allreduce.remote([1.0])
+        with pytest.raises(Exception):
+            ray_tpu.get(r_dead, timeout=30)
+        with pytest.raises(CollectiveAbortError) as exc:
+            ray_tpu.get(r_recv, timeout=30)
+        assert exc.value.group == name and exc.value.epoch == 1
+        assert time.monotonic() - t0 < 10.0, (
+            "recv burned the rendezvous deadline instead of aborting "
+            "on the gang fence")
+    finally:
+        col.destroy_collective_group(name)
+        ray_tpu.shutdown()
+
+
 def test_xla_collectives_on_mesh():
     import jax
     import jax.numpy as jnp

@@ -102,6 +102,67 @@ def test_grouping_rejects_slice_straddling_processes():
         group_devices_by_slice(devs, 2)
 
 
+def test_discovery_fallback_order():
+    """Slice-membership discovery precedence (module docstring):
+    slice_index beats process_index beats positional blocks — on the
+    SAME device population, stripping one signal at a time must land
+    on the next tier — and the not-divisible error fires in every
+    tier."""
+    # Tier 1 wins even when process boundaries disagree with slice
+    # ids: 2 hardware slices INTERLEAVED across 2 processes — the
+    # process grouping would split each slice, so slice ids must rule.
+    devs1 = [_FakeDev(i, process_index=i % 2, slice_index=i // 4)
+             for i in range(8)]
+    groups = group_devices_by_slice(devs1, 2)
+    assert [{getattr(d, "slice_index") for d in g}
+            for g in groups] == [{0}, {1}]
+
+    # Strip slice ids (even one device without an id disables the
+    # hardware tier — a partial signal cannot be trusted): the same
+    # population now groups by process.
+    devs2 = [_FakeDev(i, process_index=i // 4, slice_index=0)
+             for i in range(8)]
+    del devs2[0].slice_index
+    groups = group_devices_by_slice(devs2, 2)
+    assert [{d.process_index for d in g} for g in groups] == [{0}, {1}]
+
+    # Strip process boundaries too: positional blocks.
+    devs3 = [_FakeDev(i) for i in range(8)]
+    groups = group_devices_by_slice(devs3, 2)
+    assert [d.id for d in groups[0]] == [0, 1, 2, 3]
+    assert [d.id for d in groups[1]] == [4, 5, 6, 7]
+
+    # The not-divisible error path, with and without explicit `per`
+    # (the implicit-per division is where the message comes from).
+    with pytest.raises(ValueError, match="not divisible"):
+        group_devices_by_slice([_FakeDev(i) for i in range(7)], 2)
+    with pytest.raises(ValueError, match="not divisible"):
+        group_devices_by_slice(
+            [_FakeDev(i, process_index=i // 5) for i in range(10)], 3)
+
+
+def test_broadcast_one_slice_to_all():
+    """SNIPPETS.md [1] restore-dissemination pattern: one slice's
+    pytree reaches every slice over the cross-slice axis — numerically
+    exact, every-slice-replicated output, zeros nowhere."""
+    from ray_tpu.parallel.slice_mesh import broadcast_one_slice_to_all
+
+    topo = SliceTopology(num_slices=2, inner=MeshSpec(fsdp=2, tp=2),
+                         cross="dp")
+    smesh = make_slice_mesh(topo, jax.devices()[:8])
+    tree = {"w": np.arange(12.0).reshape(3, 4),
+            "b": np.asarray([7.0, -1.0])}
+    out = broadcast_one_slice_to_all(tree, 1, smesh)
+    for key in tree:
+        got = np.asarray(out[key])
+        np.testing.assert_array_equal(got, tree[key])
+        # replicated across slices: every device holds a full copy
+        leaf = out[key]
+        assert leaf.sharding.is_fully_replicated
+    with pytest.raises(ValueError, match="source_slice"):
+        broadcast_one_slice_to_all(tree, 5, smesh)
+
+
 def test_slice_mesh_geometry():
     topo = SliceTopology(num_slices=2, inner=MeshSpec(fsdp=2, tp=2),
                          cross="dp")
