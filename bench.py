@@ -342,6 +342,23 @@ def bench_e2e_runtime():
         # Warm the worker pool (process spawn is seconds; steady-state
         # dispatch is what the reference benchmark measures too).
         ray_tpu.get([pi_task.remote() for _ in range(16)])
+        # ... and wait for the pool to actually FINISH spawning: on a
+        # 1-core box the background python process startups contend
+        # with the measured tasks for ~2s, tripling the serial p50 of
+        # whatever runs during that window.
+        import ray_tpu._private.worker as _w
+        _pool = (_w.global_worker().node_group
+                 ._raylets[_w.global_worker().node_group.head_node_id]
+                 .worker_pool)
+        _deadline = time.monotonic() + 30
+        while time.monotonic() < _deadline:
+            with _pool._lock:
+                spawning = [w for w in _pool._all.values()
+                            if hasattr(w, "proc") and not w.ready]
+            if not spawning:
+                break
+            time.sleep(0.1)
+        ray_tpu.get([pi_task.remote() for _ in range(64)])
 
         # (a) serial submit→result round trip.
         lats = []
@@ -437,6 +454,70 @@ def bench_e2e_runtime():
             ray_tpu.shutdown()
         except Exception:
             pass
+    return out
+
+
+def bench_wire():
+    """Open-loop data-plane numbers (docs/data_plane.md): burst-submit
+    through the REAL owner<->raylet wire path — one remote raylet, so
+    submits leave as coalesced submit_many frames, completions return
+    as task_done_many pushes, and small frames ride the negotiated
+    binary protocol. Reports the pipelined throughput the 10x claim
+    is tracked by ALONGSIDE the realized coalescing factor and wire
+    cost per task, so a regression in batching shows up as a frame
+    metric, not just a throughput mystery."""
+    out = {}
+    try:
+        import ray_tpu
+        from ray_tpu._private import wire_stats
+        from ray_tpu.cluster_utils import Cluster
+
+        cluster = Cluster(head_num_cpus=1)
+        try:
+            cluster.add_node(num_cpus=8, resources={"W": 8},
+                             remote=True, max_process_workers=4)
+
+            # zero-CPU + fractional custom resource: the whole burst
+            # is schedulable at once, so the measurement is the wire
+            # pipeline, not owner-side resource throttling
+            @ray_tpu.remote(num_cpus=0, resources={"W": 0.001})
+            def tiny(i):
+                return i
+
+            # Two warm waves: the remote raylet's worker spawns run in
+            # the background for ~2s on a 1-core box and pollute
+            # whatever is measured during that window.
+            for _ in range(2):
+                ray_tpu.get([tiny.remote(i) for i in range(300)])
+            n = 2000
+            best, snap = 0.0, {}
+            for _wave in range(3):
+                wire_stats.reset()
+                t0 = time.perf_counter()
+                refs = [tiny.remote(i) for i in range(n)]
+                ray_tpu.get(refs)
+                rate = n / (time.perf_counter() - t0)
+                if rate > best:
+                    best, snap = rate, wire_stats.snapshot()
+            out["e2e_pipelined_tasks_per_sec"] = round(best, 1)
+            lease = snap.get("lease_rpc", {})
+            out["rpc_frame_avg_batch"] = round(
+                lease.get("avg_batch", 0.0), 2)
+            # full-duplex owner<->raylet wire cost of one task: bytes
+            # sent (lease frames) + received (completion pushes),
+            # driver side of the channel
+            sent = snap.get("rpc:raylet_channel", {}).get("bytes", 0)
+            rcvd = snap.get("rpcin:raylet_channel", {}).get("bytes", 0)
+            out["rpc_bytes_per_task"] = round((sent + rcvd) / n, 1)
+            out["rpc_fastframe_hits"] = (
+                snap.get("rpc:raylet_channel", {}).get(
+                    "fastframe_hits", 0)
+                + snap.get("rpcin:raylet_channel", {}).get(
+                    "fastframe_hits", 0))
+        finally:
+            cluster.shutdown()
+    except Exception as e:
+        print(f"# wire bench failed: {e!r}", file=sys.stderr)
     return out
 
 
@@ -780,6 +861,7 @@ def main():
         record["p99_light_vs_baseline"] = round(light_base_us / light_p99_us,
                                                 2)
     record.update(_run_section_subprocess("--e2e"))
+    record.update(_run_section_subprocess("--wire"))
     record.update(_run_section_subprocess("--serve"))
     record.update(_run_section_subprocess("--multislice"))
     record.update(bench_model_mfu())
@@ -794,6 +876,8 @@ def main():
 if __name__ == "__main__":
     if "--e2e" in sys.argv:
         print(json.dumps(bench_e2e_runtime()))
+    elif "--wire" in sys.argv:
+        print(json.dumps(bench_wire()))
     elif "--serve" in sys.argv:
         print(json.dumps(bench_serve()))
     elif "--multislice" in sys.argv:

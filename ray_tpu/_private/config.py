@@ -169,6 +169,42 @@ _D("raylet_channel_reconnect_ms", int, 3000,
    "reconnect after a connection loss before the node is declared "
    "lost (its tasks then retry on survivors).")
 
+# --- data-plane fast path (batched submits/completions + binary
+# small frames; see docs/data_plane.md) ---
+_D("submit_coalesce_ms", float, 2.0,
+   "Adaptive flush window of the owner's scheduling loop: while the "
+   "submission stream is bursting (the previous tick placed a real "
+   "batch — at least 4 tasks), the loop waits up to this long for "
+   "more submits before scheduling, so per-tick sendables leave as "
+   "one batch (one submit_many frame per raylet, one exec_batch "
+   "frame per worker) instead of a frame per task. A quiet stream "
+   "(serial round trips) never waits. <= 0 disables the window.")
+_D("submit_coalesce_max", int, 512,
+   "Batch-size target of the submit coalescing window: a tick stops "
+   "gathering once this many tasks are queued for scheduling.")
+_D("task_done_coalesce_ms", float, 2.0,
+   "Raylet-side completion coalescing window: task_done pushes to "
+   "one owner channel buffer up to this long (or up to "
+   "task_done_coalesce_max payloads) and leave as one "
+   "task_done_many frame. The first push after an idle window "
+   "bypasses the buffer, so serial round trips pay nothing. "
+   "<= 0 disables coalescing (every push ships alone).")
+_D("task_done_coalesce_max", int, 64,
+   "Max task_done payloads per coalesced task_done_many frame.")
+_D("worker_reply_flush_ms", float, 1.5,
+   "Worker-side completion coalescing: 'done' replies buffer until "
+   "the worker's intake is idle, this deadline passes, or "
+   "worker_reply_flush_max replies accumulate — then ship as one "
+   "('batch', ...) frame. <= 0 sends every reply alone.")
+_D("worker_reply_flush_max", int, 64,
+   "Max replies per coalesced worker ('batch', ...) frame.")
+_D("fastframe_threshold_bytes", int, 16384,
+   "RPC frames whose msgpack-safe body encodes at or below this size "
+   "ride the binary small-frame fast path (no outer pickle) when "
+   "both peers negotiated it at handshake; larger or non-msgpack "
+   "bodies fall back to the legacy pickled-tuple frame. 0 disables "
+   "the fast path.")
+
 # --- overload plane (reference: memory monitor + backpressured
 # submission; see docs/fault_tolerance.md "Overload semantics") ---
 _D("raylet_max_queued_tasks", int, 4096,
@@ -261,9 +297,13 @@ _D("health_check_failure_threshold", int, 5,
 
 # --- logging / events ---
 _D("event_log_enabled", bool, True, "Structured event log to session dir.")
-_D("event_export_enabled", bool, True,
+_D("event_export_enabled", bool, False,
    "Write JSONL event streams (TASK/ACTOR/NODE) + an end-of-session "
-   "usage_stats.json under the session dir for external collectors.")
+   "usage_stats.json under the session dir for external collectors. "
+   "Opt-in (matching the reference's export API): the TASK stream "
+   "costs two records per task, which is measurable on the data-plane "
+   "hot path. The in-memory event ring (event_log_enabled) stays on "
+   "by default and keeps powering the timeline API.")
 _D("log_level", str, "INFO", "Runtime log level.")
 _D("log_to_driver", bool, True,
    "Stream worker stdout/stderr (local files + remote raylet "

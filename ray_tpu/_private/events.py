@@ -28,6 +28,15 @@ def _buffer() -> Deque:
     return _events
 
 
+def active() -> bool:
+    """True when any event sink is on. Hot-path callers guard with
+    this BEFORE building record arguments (task_id.hex() and
+    repr_name() per transition are pure waste when both sinks are
+    off)."""
+    from ray_tpu._private import export
+    return get_config().event_log_enabled or export._writer is not None
+
+
 def record(task_id_hex: str, name: str, state: str,
            worker: str = "", extra: Optional[dict] = None) -> None:
     """Ring buffer (event_log_enabled) and JSONL export

@@ -270,6 +270,13 @@ class NodeManagerGroup:
 
         self._wake = threading.Event()
         self._shutdown = False
+        # Wire-plane stats (data-plane fast path observability): frames
+        # vs payloads through the owner's submit paths — the bench's
+        # rpc_frame_avg_batch / rpc_bytes_per_task inputs.
+        from ray_tpu._private import wire_stats
+        self.wire_stats = wire_stats
+        # hot-path accumulator held once (wire_stats.channel docstring)
+        self._reply_stats = wire_stats.channel("worker_reply")
         # bumped on node add/remove
         self._membership_version = 0  # guarded-by: _lock
         # Overload plane, owner side: shed/OOM'd specs wait out their
@@ -307,6 +314,14 @@ class NodeManagerGroup:
             target=self._io_loop, daemon=True, name="rtpu-io")
         self._sched_thread.start()
         self._io_thread.start()
+
+    def _wake_sched(self) -> None:
+        """Hot-path wake: ``Event.is_set`` is lock-free, so redundant
+        wakes (one per submission/completion in a wave) skip the event
+        lock entirely."""
+        w = self._wake
+        if not w.is_set():
+            w.set()
 
     # -- cluster membership ------------------------------------------------
 
@@ -510,9 +525,14 @@ class NodeManagerGroup:
                        spec: TaskSpec) -> None:
         """Drop the (possibly not-yet-recorded) running record and
         return the scheduler allocation — the shared unwind of every
-        not-actually-submitted remote path (requeue, shed, window)."""
+        not-actually-submitted remote path (requeue, shed, window).
+        The memoized in-flight counts are invalidated with the pop:
+        a whole lost submit_many frame unwinding N leases must not
+        keep counting them against the window until the memo expires
+        (the re-dispatch would double-count the lost frame)."""
         with self._lock:
             self._running.pop(spec.task_id, None)
+            self._inflight_cache = (-1.0, {})
         self._free_allocation(handle.node_id, spec.resources,
                               self._spec_pg(spec))
 
@@ -645,6 +665,7 @@ class NodeManagerGroup:
             statuses = handle.client.call(
                 "submit_many", [p for _s, p in sendable],
                 timeout=lease_timeout)
+            self.wire_stats.channel("lease_rpc").record(len(sendable))
         except Exception:
             statuses = None
         if (not isinstance(statuses, list)
@@ -659,6 +680,7 @@ class NodeManagerGroup:
         from ray_tpu._private import events
         requeued = False
         accepted: List[dict] = []
+        ev_on = events.active()
         for (spec, payload), status in zip(sendable, statuses):
             if status == "refused":
                 self._requeue_remote(handle, spec)
@@ -679,9 +701,10 @@ class NodeManagerGroup:
                 # admitted: a LATER shed (e.g. after a crash retry)
                 # starts its backoff from base again, not the stale cap
                 spec._shed_backoff_s = 0.0  # type: ignore[attr-defined]
-                events.record(spec.task_id.hex(), spec.repr_name(),
-                              "RUNNING",
-                              worker=f"node:{handle.node_id.hex()[:8]}")
+                if ev_on:
+                    events.record(
+                        spec.task_id.hex(), spec.repr_name(), "RUNNING",
+                        worker=f"node:{handle.node_id.hex()[:8]}")
         self._record_shipped_functions(handle, accepted)
         if requeued:
             self._wake.set()
@@ -860,6 +883,12 @@ class NodeManagerGroup:
                 self._stream_item_cb(TaskID(payload["task_id"]), results)
         elif topic == "task_done":
             self._complete_remote_task(handle, payload)
+        elif topic == "task_done_many":
+            # Coalesced completion frame (docs/data_plane.md): the
+            # payload list preserves the raylet's completion order, so
+            # per-caller ordering is exactly the unbatched behavior.
+            for done in payload:
+                self._complete_remote_task(handle, done)
         elif topic == "actor_ready":
             self._remote_actor_ready(handle, payload)
         elif topic == "actor_died":
@@ -999,14 +1028,16 @@ class NodeManagerGroup:
 
     def submit_task(self, spec: TaskSpec) -> None:
         deps = spec.dependencies()
-        ready = self.dependency_manager.add_task(
+        # dep-free fast path: skip the dependency manager's lock — the
+        # overwhelming share of hot-path submissions carry no refs
+        ready = not deps or self.dependency_manager.add_task(
             spec.task_id, deps, self._object_available)
         with self._lock:
             if ready:
                 self._to_schedule.append(spec)
             else:
                 self._waiting[spec.task_id] = spec
-        self._wake.set()
+        self._wake_sched()
 
     def _object_available(self, oid: ObjectID) -> bool:
         return self._memory_store.contains(oid)
@@ -1020,7 +1051,7 @@ class NodeManagerGroup:
                 spec = self._waiting.pop(tid, None)
                 if spec is not None:
                     self._to_schedule.append(spec)
-        self._wake.set()
+        self._wake_sched()
 
     # -- actor task routing ------------------------------------------------
 
@@ -1201,15 +1232,17 @@ class NodeManagerGroup:
                 handle.client.call(
                     "submit_batch", [p for _s, p in sendable],
                     timeout=get_config().worker_lease_timeout_ms / 1000.0)
+                self.wire_stats.channel("lease_rpc").record(len(sendable))
             except Exception:
                 with self._lock:
                     for spec, _p in sendable:
                         self._running.pop(spec.task_id, None)
                 return 0
-            wname = f"node:{handle.node_id.hex()[:8]}"
-            for spec, _p in sendable:
-                events.record(spec.task_id.hex(), spec.repr_name(),
-                              "RUNNING", worker=wname)
+            if events.active():
+                wname = f"node:{handle.node_id.hex()[:8]}"
+                for spec, _p in sendable:
+                    events.record(spec.task_id.hex(), spec.repr_name(),
+                                  "RUNNING", worker=wname)
             return len(sendable)
         sendable = []
         for spec, payload in items:
@@ -1233,15 +1266,17 @@ class NodeManagerGroup:
                     spec, node_id, worker, {})
         try:
             worker.send(("exec_actor_batch", wire))
+            self.wire_stats.channel("worker_pipe").record(len(wire))
         except Exception:
             with self._lock:
                 for spec, _p in sendable:
                     self._running.pop(spec.task_id, None)
             return 0
-        wname = worker.worker_id.hex()[:8]
-        for spec, _p in sendable:
-            events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
-                          worker=wname)
+        if events.active():
+            wname = worker.worker_id.hex()[:8]
+            for spec, _p in sendable:
+                events.record(spec.task_id.hex(), spec.repr_name(),
+                              "RUNNING", worker=wname)
         return len(sendable)
 
     @staticmethod
@@ -1446,6 +1481,10 @@ class NodeManagerGroup:
         cfg = get_config()
         batch_limit = cfg.tpu_scheduler_batch_size
         seen_membership = -1
+        last_moved = 0          # specs the previous tick scheduled
+        # no-deadline: daemon scheduler loop, exits via _shutdown; the
+        # wake wait is time-bounded and the coalescing sleep is one
+        # bounded flush window, never a poll-until-condition
         while not self._shutdown:
             self._wake.wait(timeout=0.1)
             self._wake.clear()
@@ -1454,6 +1493,22 @@ class NodeManagerGroup:
                 # run (and possibly jit-compile in) one more body
                 break
             try:
+                # Submit coalescing (data-plane fast path, layer 1):
+                # while the submission stream is BURSTING — the
+                # previous tick moved a real batch — wait a short
+                # flush window so this tick's sendables leave as one
+                # policy batch / one frame per destination instead of
+                # a frame per task. A quiet stream (previous tick
+                # moved a task or two) never waits, so serial
+                # round-trip latency is untouched.
+                coalesce_s = cfg.submit_coalesce_ms / 1000.0
+                coalesce_max = cfg.submit_coalesce_max
+                if coalesce_s > 0 and last_moved >= 4:
+                    with self._lock:
+                        depth = len(self._to_schedule)
+                    if 0 < depth < coalesce_max:
+                        time.sleep(coalesce_s)
+                        self._wake.clear()
                 # Membership changed since tasks were parked infeasible:
                 # a new node may satisfy them now.
                 if self._membership_version != seen_membership:
@@ -1472,8 +1527,8 @@ class NodeManagerGroup:
                 # every capacity change made each tick O(backlog) in
                 # the policy — the dominant cost of the normal-task
                 # path (tasks beyond free capacity just bounced back).
-                self._schedule_once(min(batch_limit,
-                                        self._free_slot_estimate()))
+                last_moved = self._schedule_once(
+                    min(batch_limit, self._free_slot_estimate()))
                 self._dispatch_all()
                 self._rescue_stalled_pipelines()
             except Exception:
@@ -1720,13 +1775,16 @@ class NodeManagerGroup:
                 return
             raylet.dispatch_queue.append(spec)
 
-    def _schedule_once(self, batch_limit: int) -> None:
+    def _schedule_once(self, batch_limit: int) -> int:
+        """Schedule up to ``batch_limit`` queued specs; returns how
+        many were actually placed this tick (the coalescing window's
+        burst signal)."""
         with self._lock:
             batch: List[TaskSpec] = []
             while self._to_schedule and len(batch) < batch_limit:
                 batch.append(self._to_schedule.popleft())
         if not batch:
-            return
+            return 0
         retry: List[TaskSpec] = []
         plain: List[TaskSpec] = []
         for spec in batch:
@@ -1795,6 +1853,7 @@ class NodeManagerGroup:
         if retry:
             with self._lock:
                 self._to_schedule.extend(retry)
+        return max(0, len(batch) - len(retry))
 
     def pending_resource_demand(self) -> List[Dict[str, float]]:
         """Resource shapes of tasks the cluster cannot currently place
@@ -1866,6 +1925,7 @@ class NodeManagerGroup:
                 worker.send(("exec", items[0][1]))
             else:
                 worker.send(("exec_batch", [p for _s, p in items]))
+            self.wire_stats.channel("worker_pipe").record(len(items))
         except Exception as e:   # worker pipe broken mid-flush
             for spec, _p in items:
                 with self._lock:
@@ -2013,53 +2073,89 @@ class NodeManagerGroup:
                     return _LostArgError(arg.object_id)
                 name, size = entry.data
                 arg_descs.append(("shm", arg.object_id.binary(), name, size))
-        payload = {
-            "type": ("create_actor"
-                     if spec.task_type == TaskType.ACTOR_CREATION_TASK
-                     else "exec"),
-            "task_id": spec.task_id.binary(),
-            "function_id": spec.function.function_id,
-            "args": arg_descs,
-            "kwargs_keys": spec.kwargs_keys,
-            "num_returns": spec.num_returns,
-            "return_ids": [o.binary() for o in spec.return_ids],
-            "name": spec.repr_name(),
-            "runtime_env": spec.runtime_env,
-            "owner_addr": self.object_server_addr,
-            "streaming": spec.streaming,
-            "stream_skip": spec.stream_skip,
-        }
+        is_exec = spec.task_type != TaskType.ACTOR_CREATION_TASK
+        fid = spec.function.function_id
+        name = spec.repr_name()
+        # Hot-path template stripping (data-plane fast path, layer 4):
+        # the constant half of a process worker's exec payload ships
+        # ONCE per (worker, function); per-task frames then carry only
+        # the varying fields ("xt" marker — worker_process.merge_exec
+        # rebuilds the full payload). Pipe FIFO guarantees the
+        # template lands first. In-process workers skip this (their
+        # payloads are never pickled, so stripping saves nothing).
+        use_tmpl = (is_exec and worker.kind == "process"
+                    and spec.num_returns == 1 and not spec.kwargs_keys
+                    and not spec.runtime_env and not spec.streaming
+                    and not spec.stream_skip)
+        if use_tmpl:
+            payload = {
+                "xt": fid,
+                "task_id": spec.task_id.binary(),
+                "args": arg_descs,
+                "return_ids": [o.binary() for o in spec.return_ids],
+            }
+            tmpl_name = worker.exec_templates.get(fid)
+            if tmpl_name is not None and tmpl_name != name:
+                payload["name"] = name
+        else:
+            payload = {
+                "type": "exec" if is_exec else "create_actor",
+                "task_id": spec.task_id.binary(),
+                "function_id": fid,
+                "args": arg_descs,
+                "kwargs_keys": spec.kwargs_keys,
+                "num_returns": spec.num_returns,
+                "return_ids": [o.binary() for o in spec.return_ids],
+                "name": name,
+                "runtime_env": spec.runtime_env,
+                "owner_addr": self.object_server_addr,
+                "streaming": spec.streaming,
+                "stream_skip": spec.stream_skip,
+            }
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
             payload["actor_id"] = spec.actor_creation_id.binary()
             payload["max_concurrency"] = spec.max_concurrency
             payload["checkpoint_interval"] = spec.checkpoint_interval
         try:
             raylet.worker_pool.ensure_function(
-                worker, spec.function.function_id,
-                lambda: self._function_blob(spec.function.function_id))
+                worker, fid, lambda: self._function_blob(fid))
+            if use_tmpl and fid not in worker.exec_templates:
+                worker.send(("exec_tmpl", fid, {
+                    "type": "exec",
+                    "function_id": fid,
+                    "kwargs_keys": [],
+                    "num_returns": 1,
+                    "name": name,
+                    "runtime_env": None,
+                    "owner_addr": self.object_server_addr,
+                    "streaming": False,
+                    "stream_skip": 0,
+                }))
+                worker.exec_templates[fid] = name
             with self._lock:
                 self._running[spec.task_id] = RunningTask(
                     spec, raylet.node_id, worker, dict(spec.resources),
                     pg=self._spec_pg(spec))
-                if payload["type"] == "exec":
+                if is_exec:
                     worker.inflight += 1
                     worker.pipeq.append(spec.task_id)
                     worker.last_activity = time.monotonic()
-            if buffers is not None and payload["type"] == "exec":
+            if buffers is not None and is_exec:
                 entry = buffers.get(id(worker))
                 if entry is None:
                     entry = buffers[id(worker)] = (worker, [])
                 entry[1].append((spec, payload))
             else:
-                worker.send(("exec" if payload["type"] == "exec"
-                             else "create_actor", payload))
+                worker.send(("exec" if is_exec else "create_actor",
+                             payload))
             from ray_tpu._private import events
-            events.record(spec.task_id.hex(), spec.repr_name(), "RUNNING",
-                          worker=worker.worker_id.hex()[:8])
+            if events.active():
+                events.record(spec.task_id.hex(), name, "RUNNING",
+                              worker=worker.worker_id.hex()[:8])
         except Exception as e:  # worker pipe broken
             with self._lock:
                 self._running.pop(spec.task_id, None)
-                if payload["type"] == "exec" and worker.inflight > 0:
+                if is_exec and worker.inflight > 0:
                     worker.inflight -= 1
                     try:
                         worker.pipeq.remove(spec.task_id)
@@ -2079,9 +2175,12 @@ class NodeManagerGroup:
     def _handle_reply(self, worker: BaseWorker, reply: tuple) -> None:
         op = reply[0]
         if op == "batch":
-            # coalesced completions (one frame, N replies)
-            for r in reply[1]:
-                self._handle_reply(worker, r)
+            # Coalesced completions (one frame, N replies). Deferred
+            # notify: entries land per reply but blocked getters wake
+            # once for the whole batch, not once per object.
+            with self._memory_store.deferred_notify():
+                for r in reply[1]:
+                    self._handle_reply(worker, r)
             return
         if op == "stream":
             # streaming generator item; the task keeps running
@@ -2129,7 +2228,7 @@ class NodeManagerGroup:
                     # the worker rejoins the pool only when drained
                     raylet.worker_pool.push_worker(worker)
                 self._free_allocation(rt.node_id, rt.resources, rt.pg)
-                self._wake.set()
+                self._wake_sched()
             self._complete_task(task_id, results, err_blob, None,
                                 timings)
         elif op == "actor_ready":
@@ -2206,6 +2305,13 @@ class NodeManagerGroup:
                     elif msg[0] == "pong":
                         pass
                     else:
+                        # realized worker->owner coalescing factor
+                        # (top-level frames only — _handle_reply
+                        # recurses into batch items)
+                        if msg[0] == "batch":
+                            self._reply_stats.record(len(msg[1]))
+                        elif msg[0] in ("done", "stream"):
+                            self._reply_stats.record(1)
                         self._handle_reply(worker, msg)
                 except Exception:
                     # Never let a completion error kill the IO thread —

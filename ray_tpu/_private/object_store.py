@@ -386,15 +386,58 @@ class MemoryStore:
     def __init__(self):
         self._store: Dict[ObjectID, object] = {}  # guarded-by: _cv
         self._cv = threading.Condition()
+        # Waiter count: a put with nobody blocked skips the notify
+        # (the kernel futex wake is the expensive half of put).
+        self._waiters = 0  # guarded-by: _cv
+        # Batched completion handling defers wakeups: entries land
+        # immediately (reads stay exact) but blocked getters are woken
+        # once per batch, not once per object.
+        self._defer_depth = 0  # guarded-by: _cv
+        self._defer_dirty = False  # guarded-by: _cv
 
     def put(self, object_id: ObjectID, value: object) -> None:
         with self._cv:
             self._store[object_id] = value
-            self._cv.notify_all()
+            if self._waiters:
+                if self._defer_depth:
+                    self._defer_dirty = True
+                else:
+                    self._cv.notify_all()
+
+    def deferred_notify(self):
+        """Context manager: puts inside the block insert immediately
+        but coalesce their wakeups into ONE notify at exit — the
+        completion-batch path's half of batched completions (a wave of
+        N inline results costs one getter wakeup, not N)."""
+        store = self
+
+        class _Defer:
+            def __enter__(self):
+                with store._cv:
+                    store._defer_depth += 1
+                return self
+
+            def __exit__(self, *exc):
+                with store._cv:
+                    store._defer_depth -= 1
+                    if store._defer_depth == 0 and store._defer_dirty:
+                        store._defer_dirty = False
+                        if store._waiters:
+                            store._cv.notify_all()
+                return False
+
+        return _Defer()
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._cv:
             return object_id in self._store
+
+    def get_ready(self, object_ids) -> Dict[ObjectID, object]:
+        """Snapshot of the already-present subset, one lock
+        acquisition for the whole list (the get() fast pre-pass)."""
+        with self._cv:
+            store = self._store
+            return {o: store[o] for o in object_ids if o in store}
 
     def get(self, object_id: ObjectID,
             timeout: Optional[float] = None) -> object:
@@ -406,7 +449,11 @@ class MemoryStore:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         raise TimeoutError(f"timed out waiting for {object_id}")
-                self._cv.wait(remaining)
+                self._waiters += 1
+                try:
+                    self._cv.wait(remaining)
+                finally:
+                    self._waiters -= 1
             return self._store[object_id]
 
     def wait(self, object_ids: List[ObjectID], num_returns: int,
@@ -422,13 +469,23 @@ class MemoryStore:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
-                self._cv.wait(remaining)
+                self._waiters += 1
+                try:
+                    self._cv.wait(remaining)
+                finally:
+                    self._waiters -= 1
             not_ready = {o for o in object_ids if o not in ready}
             return ready, not_ready
 
     def free(self, object_id: ObjectID) -> None:
         with self._cv:
             self._store.pop(object_id, None)
+
+    def pop(self, object_id: ObjectID):
+        """Remove and return the entry (None when absent) — lets the
+        ref-zero path inspect what it freed without a second lock."""
+        with self._cv:
+            return self._store.pop(object_id, None)
 
     def __len__(self) -> int:
         with self._cv:

@@ -124,6 +124,35 @@ class RayletServer:
         self._actor_demand: Dict[bytes, Dict[str, float]] = {}
         self._wake = threading.Event()
         self._shutdown = threading.Event()
+        # Completion coalescing (data-plane fast path, layer 2): owner
+        # pushes buffer here and leave as one task_done_many frame per
+        # flush — size- and deadline-bounded; the first push after an
+        # idle window bypasses the buffer (serial round trips pay no
+        # added latency). Order is preserved: non-task_done topics
+        # flush the buffer ahead of themselves, so e.g. an actor_ckpt
+        # commit can never overtake the completions it covers.
+        from ray_tpu._private import wire_stats
+        self._push_stats = wire_stats.channel("completion_push")
+        self._push_coalesce_s = max(0.0,
+                                    cfg.task_done_coalesce_ms / 1000.0)
+        self._push_coalesce_max = max(1, cfg.task_done_coalesce_max)
+        # unbounded-ok: _push_owner_buffered flushes the moment depth
+        # reaches _push_coalesce_max, so occupancy never exceeds it
+        self._push_buf: deque = deque()  # guarded-by: _push_lock
+        self._push_lock = threading.Lock()
+        # Serializes drain+send sequences (NOT individual pushes):
+        # draining under _push_lock but sending outside it would let a
+        # flush-ahead topic (e.g. an actor_ckpt commit) observe an
+        # empty buffer while the drained completions it must trail are
+        # still unsent in another thread — the commit would overtake
+        # its completions on the wire. Lock order: _push_order_lock ->
+        # _push_lock -> (ctx._send_lock inside push); never reversed.
+        self._push_order_lock = threading.Lock()
+        self._push_armed = threading.Event()
+        self._last_push_ts = 0.0  # guarded-by: _push_lock
+        if self._push_coalesce_s > 0:
+            threading.Thread(target=self._push_flush_loop, daemon=True,
+                             name="rtpu-raylet-pushflush").start()
         self.num_pulled = 0   # objects fetched from peers (transfer stat)
         # Overload plane (see docs/fault_tolerance.md "Overload
         # semantics"): bounded scheduler intake + node memory watchdog.
@@ -291,6 +320,90 @@ class RayletServer:
             now_owner = self._owner_ctx
         if now_owner is not None and now_owner.alive:
             self._drain_undelivered(now_owner)
+
+    # -- completion-push coalescing (docs/data_plane.md) ----------------
+
+    def _push_owner_buffered(self, topic: str, payload,
+                             ctx: Optional[ConnectionContext] = None
+                             ) -> None:
+        """Ordered owner-push entry point for EVERY topic. task_done
+        pushes coalesce into task_done_many frames; everything else
+        flushes the buffer first and ships alone — the owner observes
+        exactly the raylet's push order, so the PR-2 replay contract
+        (exactly-once, per-caller order) and the PR-5 commit-after-
+        completions ordering survive batching unchanged."""
+        if self._push_coalesce_s <= 0:
+            self._push_owner(topic, payload, ctx=ctx)
+            return
+        if topic != "task_done":
+            # Order fence: ship the buffered completions AND this
+            # topic as one serialized sequence — a concurrent drain
+            # must not leave this push overtaking completions it must
+            # trail (PR-5: commits never outrun their results).
+            with self._push_order_lock:
+                self._flush_pushes_locked()
+                self._push_owner(topic, payload, ctx=ctx)
+            return
+        now = time.monotonic()
+        direct = False
+        with self._push_lock:
+            if (not self._push_buf
+                    and now - self._last_push_ts > self._push_coalesce_s):
+                direct = True       # idle stream: don't tax latency
+            else:
+                self._push_buf.append((payload, ctx))
+                depth = len(self._push_buf)
+            self._last_push_ts = now
+        if direct:
+            # the order lock covers the (buffer-was-empty, send) pair:
+            # a drain racing in between could otherwise ship LATER
+            # buffered completions ahead of this one
+            with self._push_order_lock:
+                self._push_stats.record(1)
+                self._push_owner("task_done", payload, ctx=ctx)
+        elif depth >= self._push_coalesce_max:
+            self._flush_pushes()
+        elif depth == 1:
+            self._push_armed.set()
+
+    def _flush_pushes(self) -> None:
+        with self._push_order_lock:
+            self._flush_pushes_locked()
+
+    def _flush_pushes_locked(self) -> None:  # lock-held: _push_order_lock
+        with self._push_lock:
+            if not self._push_buf:
+                return
+            items = list(self._push_buf)
+            self._push_buf.clear()
+        # group ADJACENT same-connection runs: order within the buffer
+        # is exactly completion order and must survive the grouping
+        i = 0
+        while i < len(items):
+            ctx = items[i][1]
+            j = i
+            while j < len(items) and items[j][1] is ctx:
+                j += 1
+            run = [p for p, _c in items[i:j]]
+            self._push_stats.record(len(run))
+            if len(run) == 1:
+                self._push_owner("task_done", run[0], ctx=ctx)
+            else:
+                self._push_owner("task_done_many", run, ctx=ctx)
+            i = j
+
+    def _push_flush_loop(self) -> None:
+        # no-deadline: daemon flusher; each pass blocks on the arm
+        # event, then bounds buffered completions' age by one window
+        while not self._shutdown.is_set():
+            if not self._push_armed.wait(timeout=0.5):
+                continue
+            self._push_armed.clear()
+            time.sleep(self._push_coalesce_s)
+            try:
+                self._flush_pushes()
+            except Exception:
+                logger.exception("completion push flush failed")
 
     def _ctx_for_task(self, task_id: bytes, pop: bool = False
                       ) -> Optional[ConnectionContext]:
@@ -501,7 +614,7 @@ class RayletServer:
                 queued = False
             worker = self._running.get(task_id)
         if queued:
-            self._push_owner("task_done", {
+            self._push_owner_buffered("task_done", {
                 "task_id": task_id, "results": [], "error_blob": None,
                 "system_error": "cancelled by owner"},
                 ctx=self._ctx_for_task(task_id, pop=True))
@@ -624,7 +737,7 @@ class RayletServer:
         from ray_tpu.exceptions import TaskError
         blob = serialization.get_context().serialize(
             TaskError(err, payload.get("name", "?"), str(err))).to_bytes()
-        self._push_owner("task_done", {
+        self._push_owner_buffered("task_done", {
             "task_id": payload["task_id"], "results": [],
             "error_blob": blob, "system_error": None},
             ctx=self._ctx_for_task(payload["task_id"], pop=True))
@@ -634,7 +747,7 @@ class RayletServer:
         with self._lock:
             worker = self._actor_workers.get(actor_id)
         if worker is None or not worker.alive:
-            self._push_owner("task_done", {
+            self._push_owner_buffered("task_done", {
                 "task_id": payload["task_id"], "results": [],
                 "error_blob": None, "system_error": "actor worker dead"},
                 ctx=self._ctx_for_task(payload["task_id"], pop=True))
@@ -648,7 +761,7 @@ class RayletServer:
         except ObjectLocationError as e:
             if not actor:
                 self.worker_pool.push_worker(worker)
-            self._push_owner("task_done", {
+            self._push_owner_buffered("task_done", {
                 "task_id": payload["task_id"], "results": [],
                 "error_blob": None, "system_error": f"lost argument: {e}",
                 "lost_arg": getattr(e, "oid_bytes", None)},
@@ -678,7 +791,7 @@ class RayletServer:
                 self._running_meta.pop(payload["task_id"], None)
             if not actor:
                 self.worker_pool.push_worker(worker)
-            self._push_owner("task_done", {
+            self._push_owner_buffered("task_done", {
                 "task_id": payload["task_id"], "results": [],
                 "error_blob": None,
                 "system_error": f"worker send failed: {e}"},
@@ -776,7 +889,7 @@ class RayletServer:
                     shipped.append((oid_b, "remote", size, contained))
                 else:
                     shipped.append((oid_b, kind, data, contained))
-            self._push_owner("task_stream", {"task_id": task_id,
+            self._push_owner_buffered("task_stream", {"task_id": task_id,
                                              "results": shipped},
                              ctx=self._ctx_for_task(task_id))
             return
@@ -803,7 +916,7 @@ class RayletServer:
                     shipped.append((oid_b, "remote", size, contained))
                 else:
                     shipped.append((oid_b, kind, data, contained))
-            self._push_owner("task_done", {
+            self._push_owner_buffered("task_done", {
                 "task_id": task_id, "results": shipped,
                 "error_blob": err_blob, "system_error": None,
                 "timings": timings},
@@ -815,9 +928,9 @@ class RayletServer:
             _, actor_id, info = reply
             with self._lock:
                 ckpt_ctx = self._actor_ctx.get(actor_id)
-            self._push_owner("actor_ckpt",
-                             {"actor_id": actor_id, "info": info},
-                             ctx=ckpt_ctx)
+            self._push_owner_buffered(
+                "actor_ckpt", {"actor_id": actor_id, "info": info},
+                ctx=ckpt_ctx)
         elif op == "actor_ready":
             _, actor_id, err_blob = reply[:3]
             restore = reply[3] if len(reply) > 3 else None
@@ -846,7 +959,7 @@ class RayletServer:
                     pass    # pipe broken: worker is already dying
                 if orphaned:
                     return   # nobody left to tell
-            self._push_owner("actor_ready", {
+            self._push_owner_buffered("actor_ready", {
                 "actor_id": actor_id, "error_blob": err_blob,
                 "restore": restore},
                 ctx=(self._ctx_for_task(tid, pop=True)
@@ -877,14 +990,14 @@ class RayletServer:
                 # Killed by the memory watchdog: ship the typed marker
                 # so the owner routes it through the OOM retry budget
                 # (or surfaces OutOfMemoryError for non-retryable work).
-                self._push_owner("task_done", {
+                self._push_owner_buffered("task_done", {
                     "task_id": tid, "results": [], "error_blob": None,
                     "system_error": "task killed by the node memory "
                                     "watchdog (memory pressure)",
                     "oom": True, "oom_retryable": oom[tid]},
                     ctx=self._ctx_for_task(tid, pop=True))
                 continue
-            self._push_owner("task_done", {
+            self._push_owner_buffered("task_done", {
                 "task_id": tid, "results": [], "error_blob": None,
                 "system_error": "worker process died while executing task"},
                 ctx=self._ctx_for_task(tid, pop=True))
@@ -892,7 +1005,7 @@ class RayletServer:
             with self._lock:
                 creation_ctx = self._actor_ctx.get(aid)
             self._forget_actor(aid, "worker process died")
-            self._push_owner("actor_died", {"actor_id": aid},
+            self._push_owner_buffered("actor_died", {"actor_id": aid},
                              ctx=creation_ctx)
         self._wake.set()
 
@@ -937,9 +1050,17 @@ class RayletServer:
         ``worker_rss`` sub-dict becomes the per-worker RSS series and
         the dashboard nodes table's memory column (reporter-agent
         role)."""
+        from ray_tpu._private import wire_stats
         from ray_tpu._private.profiling import worker_rss_map
         store = self.shm_store.stats()
         rss = worker_rss_map(self.worker_pool)
+        # Wire-plane observability (docs/data_plane.md): this raylet
+        # process's channel counters (completion pushes, rpc frames)
+        # plus the idempotency dedupe hit rate — the driver folds the
+        # "wire" sub-dict into ray_tpu_rpc_batch_size{channel} /
+        # ray_tpu_rpc_fastframe_hits and exports the scalars as
+        # per-node ray_tpu_node_stat series.
+        idem = self.server.idem_calls
         with self._lock:
             return {
                 "queued_tasks": len(self._dispatch_queue),
@@ -953,6 +1074,11 @@ class RayletServer:
                 "workers": self.worker_pool.stats()["total"],
                 "workers_rss_bytes": sum(rss.values()),
                 "worker_rss": rss,
+                "dedupe_hits": self.server.dedupe_hits,
+                "dedupe_calls": idem,
+                "dedupe_hit_rate": (self.server.dedupe_hits / idem
+                                    if idem else 0.0),
+                "wire": wire_stats.snapshot(),
             }
 
     # -- memory watchdog -----------------------------------------------

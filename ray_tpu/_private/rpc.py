@@ -66,14 +66,38 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from ray_tpu._private import chaos
+import msgpack
+
+from ray_tpu._private import chaos, wire_stats
 from ray_tpu.exceptions import SystemOverloadError
 
 logger = logging.getLogger(__name__)
 
 PROTOCOL_VERSION = 1
 _MAGIC = b"RTP" + bytes([PROTOCOL_VERSION])
+# Binary small-frame fast path (docs/data_plane.md): same header
+# layout, second magic. The body is msgpack (method + token +
+# pre-serialized byte payloads packed natively) with NO outer pickle —
+# cheaper to encode and, for the control-plane methods it is allowed
+# on, safe to decode without running arbitrary reducers. Negotiated at
+# handshake; un-negotiated channels never see this magic.
+_FAST_MAGIC = b"RTF" + bytes([PROTOCOL_VERSION])
 _HDR = struct.Struct(">4sQ")
+
+# Methods/topics whose wire shapes are built from primitives by OUR
+# code on both ends (tuple->list normalization under msgpack is
+# harmless there). Arbitrary user payloads (exceptions, custom types)
+# fail msgpack encoding and fall back to the legacy pickled frame —
+# but only frames for these names are even attempted:
+_FASTFRAME_SAFE = frozenset((
+    "submit", "submit_many", "submit_batch", "register_owner", "ping",
+    "task_done", "task_done_many", "task_stream", "actor_ckpt",
+    "actor_ready", "actor_died", "report_resources", "heartbeat",
+    "cancel_task", "kill_actor",
+))
+# A reply rides the fast path only when the CALL it answers was
+# fastframe-eligible (the server knows the method) — a fast reply to
+# an arbitrary handler could silently turn a tuple result into a list.
 
 _TOKEN_ENV = "RTPU_SESSION_TOKEN"
 _token_lock = threading.Lock()
@@ -208,8 +232,32 @@ def _hard_close(sock: socket.socket) -> None:
         pass    # already closed
 
 
+def _fastframe_threshold() -> int:
+    from ray_tpu._private.config import get_config
+    return get_config().fastframe_threshold_bytes
+
+
+def _encode_frame(obj, fast: bool) -> Tuple[bytes, bool]:
+    """(frame bytes, used_fast). ``fast`` means the channel negotiated
+    the binary small-frame path AND the caller deemed this frame's
+    method eligible; the encoder still falls back to the legacy pickle
+    frame when the body doesn't msgpack (arbitrary objects) or exceeds
+    the small-frame threshold."""
+    if fast:
+        threshold = _fastframe_threshold()
+        if threshold > 0:
+            try:
+                data = msgpack.packb(obj, use_bin_type=True)
+            except (TypeError, ValueError, OverflowError):
+                data = None
+            if data is not None and len(data) <= threshold:
+                return _HDR.pack(_FAST_MAGIC, len(data)) + data, True
+    data = pickle.dumps(obj, protocol=5)
+    return _HDR.pack(_MAGIC, len(data)) + data, False
+
+
 def _send_frame(sock: socket.socket, obj, lock: Optional[threading.Lock],
-                component: str = "") -> None:
+                component: str = "", fast: bool = False) -> None:
     dup = False
     if chaos._plane.armed:
         action = chaos.fire(component, "send", _frame_method(obj))
@@ -219,8 +267,10 @@ def _send_frame(sock: socket.socket, obj, lock: Optional[threading.Lock],
             _hard_close(sock)
             raise ConnectionError("chaos: connection severed at send")
         dup = action == "dup"
-    data = pickle.dumps(obj, protocol=5)
-    frame = _HDR.pack(_MAGIC, len(data)) + data
+    frame, used_fast = _encode_frame(obj, fast)
+    if component:
+        wire_stats.channel(f"rpc:{component}").record(
+            1, len(frame), fastframe=used_fast)
     if dup:
         frame = frame + frame
     if lock is not None:
@@ -244,13 +294,22 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def _recv_frame(sock: socket.socket, component: str = ""):
     while True:
         magic, length = _HDR.unpack(_recv_exact(sock, _HDR.size))
-        if magic != _MAGIC:
-            if magic[:3] == _MAGIC[:3]:
+        if component:
+            # inbound wire cost, kept on a separate channel so the
+            # send-side payloads/frames coalescing ratio stays pure
+            wire_stats.channel(f"rpcin:{component}").record(
+                1, _HDR.size + length, fastframe=magic == _FAST_MAGIC)
+        if magic == _MAGIC:
+            obj = pickle.loads(_recv_exact(sock, length))
+        elif magic == _FAST_MAGIC:
+            obj = tuple(msgpack.unpackb(_recv_exact(sock, length),
+                                        raw=False, strict_map_key=False))
+        else:
+            if magic[:3] in (_MAGIC[:3], _FAST_MAGIC[:3]):
                 raise ProtocolError(
                     f"peer protocol version {magic[3]} != "
                     f"{PROTOCOL_VERSION}")
             raise ProtocolError(f"bad frame magic {magic!r}")
-        obj = pickle.loads(_recv_exact(sock, length))
         if chaos._plane.armed:
             action = chaos.fire(component, "recv", _frame_method(obj))
             if action == "drop":
@@ -352,12 +411,14 @@ class ConnectionContext:
         self.peer = peer
         self.component = component
         self.alive = True
+        self.fastframe = False   # negotiated at handshake
         self.meta: Dict[str, Any] = {}   # handler scratch (e.g. node id)
 
     def push(self, topic: str, payload) -> bool:
         try:
             _send_frame(self._sock, ("push", topic, payload),
-                        self._send_lock, component=self.component)
+                        self._send_lock, component=self.component,
+                        fast=self.fastframe and topic in _FASTFRAME_SAFE)
             return True
         except OSError:
             self.alive = False
@@ -381,6 +442,7 @@ class RpcServer:
         from ray_tpu._private.config import get_config
         self._dedupe = _DedupeCache(get_config().rpc_dedupe_cache_size)
         self.dedupe_hits = 0        # replayed replies (observability)
+        self.idem_calls = 0         # tokened calls seen (hit-rate denom)
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -389,7 +451,7 @@ class RpcServer:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 ctx = ConnectionContext(sock, self.client_address,
                                         component=outer._component)
-                if not outer._handshake(sock):
+                if not outer._handshake(sock, ctx):
                     return
                 with outer._live_lock:
                     outer._live.add(ctx)
@@ -421,11 +483,16 @@ class RpcServer:
             daemon=True, name=f"rtpu-rpc-{self.address[1]}")
         self._thread.start()
 
-    def _handshake(self, sock: socket.socket) -> bool:
+    def _handshake(self, sock: socket.socket,
+                   ctx: Optional[ConnectionContext] = None) -> bool:
         """First frame on every connection must be a matching hello.
         Refusals are explicit (hello_err + close), never silent. The
         handshake runs under a deadline so a silent peer cannot pin a
-        handler thread and fd forever."""
+        handler thread and fd forever. A 4th hello element carries the
+        client's feature offer ({"feats": [...]}); the reply echoes
+        the intersection, so the binary small-frame fast path only
+        runs on channels where BOTH ends opted in (legacy 3-element
+        hellos keep working and never see a fast frame)."""
         def refuse(reason: str) -> bool:
             try:
                 _send_frame(sock, ("hello_err", reason), None)
@@ -441,10 +508,13 @@ class RpcServer:
             return refuse(str(e))
         except (ConnectionError, OSError, EOFError):
             return False
-        if not (isinstance(msg, tuple) and len(msg) == 3
+        if not (isinstance(msg, tuple) and len(msg) in (3, 4)
                 and msg[0] == "hello"):
             return refuse("expected hello handshake frame")
-        _, version, token = msg
+        version, token = msg[1], msg[2]
+        offered = ()
+        if len(msg) == 4 and isinstance(msg[3], dict):
+            offered = tuple(msg[3].get("feats") or ())
         if version != PROTOCOL_VERSION:
             return refuse(f"protocol version mismatch: client speaks "
                           f"{version}, server speaks {PROTOCOL_VERSION}")
@@ -453,8 +523,14 @@ class RpcServer:
         if expected and token != expected:
             return refuse("session token mismatch: connection refused "
                           "(pass the session's RTPU_SESSION_TOKEN)")
+        accepted = []
+        if "fastframe" in offered and _fastframe_threshold() > 0:
+            accepted.append("fastframe")
+            if ctx is not None:
+                ctx.fastframe = True
         try:
-            _send_frame(sock, ("hello_ok",), None)
+            _send_frame(sock, (("hello_ok", {"feats": accepted})
+                               if accepted else ("hello_ok",)), None)
         except OSError:
             return False
         return True
@@ -494,6 +570,7 @@ class RpcServer:
             idem = msg[4] if len(msg) > 4 else None
             reply = None
             if idem is not None:
+                self.idem_calls += 1
                 recorded = self._dedupe.begin(idem)
                 if recorded is not None:
                     self.dedupe_hits += 1
@@ -518,7 +595,9 @@ class RpcServer:
                 reply = ("reply", req_id, ok, payload)
             try:
                 _send_frame(ctx._sock, reply, ctx._send_lock,
-                            component=self._component)
+                            component=self._component,
+                            fast=(ctx.fastframe
+                                  and method in _FASTFRAME_SAFE))
             except OSError:
                 raise      # socket is gone; connection teardown handles it
             except Exception as e:  # unpicklable result or exception
@@ -569,34 +648,30 @@ class RpcClient:
         self._on_push = on_push
         self._on_close = on_close
         self._component = component
-        self._sock = socket.create_connection(self.address,
-                                              timeout=connect_timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        # Version + token handshake before anything else rides the wire.
-        _send_frame(self._sock,
-                    ("hello", PROTOCOL_VERSION,
-                     token if token is not None else get_session_token()),
-                    None)
-        try:
-            hello = _recv_frame(self._sock)
-        except (ConnectionError, OSError, EOFError) as e:
-            self._sock.close()
-            if isinstance(e, ProtocolError):
-                raise       # bad magic / version: genuinely unretryable
-            # A reset/EOF mid-handshake is a TRANSIENT fault (e.g. a
-            # reconnect racing a server restart on the same port), not
-            # a refusal: surface ConnectionError so retrying clients
-            # back off and try again instead of giving the peer up for
-            # good. ProtocolError is reserved for explicit refusals
-            # (hello_err) and version/magic mismatches.
-            raise ConnectionError(
-                f"server at {self.address} closed during handshake "
-                f"({e})") from e
+        self.fastframe = False
+        hello_token = token if token is not None else get_session_token()
+        offer = ["fastframe"] if _fastframe_threshold() > 0 else []
+        hello = self._connect_handshake(hello_token, offer,
+                                        connect_timeout)
         if hello[0] != "hello_ok":
             reason = hello[1] if len(hello) > 1 else "refused"
-            self._sock.close()
-            raise ProtocolError(
-                f"server at {self.address} refused connection: {reason}")
+            if offer and isinstance(reason, str) \
+                    and "expected hello" in reason:
+                # Mixed-version channel: a pre-negotiation server
+                # refuses the 4-element hello outright. Retry once the
+                # legacy way, with the fast path off — old and new
+                # peers keep interoperating.
+                self._sock.close()
+                hello = self._connect_handshake(hello_token, [],
+                                                connect_timeout)
+            if hello[0] != "hello_ok":
+                reason = hello[1] if len(hello) > 1 else "refused"
+                self._sock.close()
+                raise ProtocolError(
+                    f"server at {self.address} refused connection: "
+                    f"{reason}")
+        if len(hello) > 1 and isinstance(hello[1], dict):
+            self.fastframe = "fastframe" in (hello[1].get("feats") or ())
         self._sock.settimeout(None)
         self._send_lock = threading.Lock()
         self._pending: Dict[int, queue.Queue] = {}
@@ -621,6 +696,35 @@ class RpcClient:
             target=self._read_loop, daemon=True,
             name=f"rtpu-rpc-client-{self.address[1]}")
         self._reader.start()
+
+    def _connect_handshake(self, token: Optional[str], offer,
+                           connect_timeout: float):
+        """Dial and run the hello exchange; returns the server's hello
+        reply frame. A non-empty ``offer`` rides as a 4th hello
+        element ({"feats": [...]}) — always on the LEGACY pickled
+        frame, since nothing is negotiated yet."""
+        self._sock = socket.create_connection(self.address,
+                                              timeout=connect_timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        hello = (("hello", PROTOCOL_VERSION, token,
+                  {"feats": list(offer)})
+                 if offer else ("hello", PROTOCOL_VERSION, token))
+        _send_frame(self._sock, hello, None)
+        try:
+            return _recv_frame(self._sock)
+        except (ConnectionError, OSError, EOFError) as e:
+            self._sock.close()
+            if isinstance(e, ProtocolError):
+                raise       # bad magic / version: genuinely unretryable
+            # A reset/EOF mid-handshake is a TRANSIENT fault (e.g. a
+            # reconnect racing a server restart on the same port), not
+            # a refusal: surface ConnectionError so retrying clients
+            # back off and try again instead of giving the peer up for
+            # good. ProtocolError is reserved for explicit refusals
+            # (hello_err) and version/magic mismatches.
+            raise ConnectionError(
+                f"server at {self.address} closed during handshake "
+                f"({e})") from e
 
     def _push_loop(self) -> None:
         while True:
@@ -686,7 +790,9 @@ class RpcClient:
                  else ("call", req_id, method, args, idem))
         try:
             _send_frame(self._sock, frame, self._send_lock,
-                        component=self._component)
+                        component=self._component,
+                        fast=(self.fastframe
+                              and method in _FASTFRAME_SAFE))
         except (ConnectionError, OSError) as e:
             # Send failed: the waiter will never be answered — drop it
             # before surfacing, or the entry leaks in _pending forever.
@@ -733,7 +839,9 @@ class RpcClient:
             raise ConnectionError("rpc connection closed")
         try:
             _send_frame(self._sock, ("oneway", method, args),
-                        self._send_lock, component=self._component)
+                        self._send_lock, component=self._component,
+                        fast=(self.fastframe
+                              and method in _FASTFRAME_SAFE))
         except (ConnectionError, OSError) as e:
             self._send_failed(method, e)
 

@@ -190,10 +190,16 @@ class ClusterResourceManager:
                 return None
             dirty: Set[NodeID] = set()
             membership = False
-            for v, nid, member in self._log:
-                if v > version:
-                    dirty.add(nid)
-                    membership = membership or member
+            # Newest-first, stopping at the caller's version: the log
+            # is append-only with increasing versions, so the scan is
+            # O(changes since last call), not O(log capacity) — a full
+            # 4096-entry sweep per scheduling tick was the single
+            # biggest fixed cost of the hot scheduling loop.
+            for v, nid, member in reversed(self._log):
+                if v <= version:
+                    break
+                dirty.add(nid)
+                membership = membership or member
             return dirty, membership
 
     def snapshot(self) -> Dict[NodeID, NodeResources]:

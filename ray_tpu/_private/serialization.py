@@ -28,12 +28,13 @@ _ALIGN = 8
 class SerializedObject:
     """A serialized value: metadata bytes + list of zero-copy buffers."""
 
-    __slots__ = ("meta", "buffers", "contained_refs")
+    __slots__ = ("meta", "buffers", "contained_refs", "_header")
 
     def __init__(self, meta: bytes, buffers: List[memoryview], contained_refs):
         self.meta = meta
         self.buffers = buffers
         self.contained_refs = contained_refs
+        self._header: Optional[bytes] = None
 
     def total_bytes(self) -> int:
         total = len(self.meta)
@@ -82,20 +83,52 @@ def _aligned(n: int) -> int:
 
 
 def _pack_header(obj: SerializedObject) -> bytes:
-    payload = msgpack.packb(
-        {
-            "m": len(obj.meta),
-            "b": [b.nbytes for b in obj.buffers],
-            "r": [r.binary() for r in obj.contained_refs],
-        }
-    )
-    return len(payload).to_bytes(4, "little") + payload
+    # Memoized on the object: the store path asks for the header twice
+    # (size accounting, then the write), and packing it is pure.
+    header = obj._header
+    if header is None:
+        payload = msgpack.packb(
+            {
+                "m": len(obj.meta),
+                "b": [b.nbytes for b in obj.buffers],
+                "r": [r.binary() for r in obj.contained_refs],
+            }
+        )
+        header = len(payload).to_bytes(4, "little") + payload
+        obj._header = header
+    return header
 
 
 def _unpack_header(blob: memoryview) -> Tuple[dict, int]:
     hlen = int.from_bytes(bytes(blob[:4]), "little")
     header = msgpack.unpackb(bytes(blob[4:4 + hlen]))
     return header, 4 + hlen
+
+
+# Types whose instances C pickle serializes with semantics identical to
+# cloudpickle's (by value / by reduce; they cannot smuggle a __main__
+# class that cloudpickle would have pickled by value). The C pickler is
+# ~10x faster than cloudpickle's Python Pickler subclass, and these
+# exact types cover the overwhelming share of hot-path task results
+# (scalars, strings, small bytes, numpy arrays).
+_FAST_PICKLE_SCALARS = frozenset(
+    (type(None), bool, int, float, complex, bytes, str))
+
+
+def _fast_picklable(value) -> bool:
+    t = type(value)
+    if t in _FAST_PICKLE_SCALARS:
+        return True
+    # exact numpy types (ndarray, numpy scalars) reduce identically
+    # under pickle and cloudpickle; subclasses fall through to
+    # cloudpickle, which knows how to handle dynamic classes. EXCEPT
+    # object-dtype arrays: their reduction pickles every element, and
+    # elements may need cloudpickle (lambdas, local classes) — those
+    # must keep the cloudpickle path.
+    if t.__module__ != "numpy":
+        return False
+    dt = getattr(value, "dtype", None)
+    return dt is None or dt.kind != "O"
 
 
 class SerializationContext:
@@ -125,9 +158,17 @@ class SerializationContext:
             return False  # out-of-band
 
         try:
-            meta = cloudpickle.dumps(
-                value, protocol=5, buffer_callback=buffer_cb
-            )
+            if _fast_picklable(value):
+                # Hot path: the C pickler for plain scalars / numpy
+                # values — byte-compatible with cloudpickle output
+                # (pickle.loads reads both), ~10x cheaper per call.
+                meta = pickle.dumps(
+                    value, protocol=5, buffer_callback=buffer_cb
+                )
+            else:
+                meta = cloudpickle.dumps(
+                    value, protocol=5, buffer_callback=buffer_cb
+                )
         finally:
             self._thread.contained_refs = None
         views = [b.raw() for b in buffers]

@@ -78,6 +78,21 @@ def install_runtime_metrics() -> None:
     restore_ms = m.Gauge(
         "ray_tpu_restore_ms",
         "Duration of the most recent successful checkpoint restore")
+    rpc_batch = m.Gauge(
+        "ray_tpu_rpc_batch_size",
+        "Realized payloads-per-frame coalescing factor per wire "
+        "channel (docs/data_plane.md): driver-local channels plus "
+        "the per-raylet channels reported in heartbeats, summed "
+        "across nodes", tag_keys=("channel",))
+    rpc_fastframe = m.Gauge(
+        "ray_tpu_rpc_fastframe_hits",
+        "Frames shipped on the negotiated binary small-frame fast "
+        "path (all channels, driver + heartbeat-reported)")
+    rpc_dedupe_rate = m.Gauge(
+        "ray_tpu_rpc_dedupe_hit_rate",
+        "Idempotency dedupe-cache hit rate across raylet rpc "
+        "servers (heartbeat-reported; >0 means retries/duplicate "
+        "frames were collapsed)")
 
     def collect():
         from ray_tpu._private.worker import try_global_worker
@@ -136,5 +151,36 @@ def install_runtime_metrics() -> None:
                         tags={"state": "discarded"})
         ckpt_bytes.set(getattr(w, "ckpt_bytes_total", 0))
         restore_ms.set(getattr(w, "last_restore_ms", 0.0))
+        # Wire-plane gauges (docs/data_plane.md): merge this process's
+        # channel counters with each live raylet's heartbeat-reported
+        # "wire" sub-dict (same channel name sums across nodes).
+        from ray_tpu._private import wire_stats
+        merged: dict = {name: dict(snap)
+                        for name, snap in wire_stats.snapshot().items()}
+        dedupe_hits = dedupe_calls = 0
+        for _nid, (_ts, nstats) in list(w.node_stats.items()):
+            dedupe_hits += nstats.get("dedupe_hits", 0)
+            dedupe_calls += nstats.get("dedupe_calls", 0)
+            wire = nstats.get("wire")
+            if not isinstance(wire, dict):
+                continue
+            for name, snap in wire.items():
+                agg = merged.setdefault(
+                    name, {"frames": 0, "payloads": 0, "bytes": 0,
+                           "fastframe_hits": 0})
+                for k in ("frames", "payloads", "bytes",
+                          "fastframe_hits"):
+                    agg[k] = agg.get(k, 0) + snap.get(k, 0)
+        rpc_batch.clear()   # stopped-beating nodes' channels vanish
+        fastframe_hits = 0
+        for name, snap in merged.items():
+            frames = snap.get("frames", 0)
+            if frames:
+                rpc_batch.set(snap.get("payloads", 0) / frames,
+                              tags={"channel": name})
+            fastframe_hits += snap.get("fastframe_hits", 0)
+        rpc_fastframe.set(fastframe_hits)
+        rpc_dedupe_rate.set(dedupe_hits / dedupe_calls
+                            if dedupe_calls else 0.0)
 
     m.register_collector(collect)

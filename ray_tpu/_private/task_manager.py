@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from ray_tpu._private import serialization
+from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.task_spec import TaskSpec
 from ray_tpu.exceptions import (
@@ -53,11 +54,14 @@ def _contained_item(c):
     """Normalize a wire contained-ref item. Plain bytes = driver-owned
     (classic containment pinning); a (bytes, owner_addr) pair is a
     worker-owned ref whose borrow the sender pre-registered — adopt a
-    ref object so the borrow releases when the container frees."""
-    if isinstance(c, tuple) and len(c) == 2 and c[1] is not None:
+    ref object so the borrow releases when the container frees.
+    Accepts list spellings of the pair too: a completion that rode the
+    binary small-frame path (docs/data_plane.md) arrives with msgpack's
+    tuple->list normalization applied."""
+    if isinstance(c, (tuple, list)) and len(c) == 2 and c[1] is not None:
         from ray_tpu._private.object_ref import adopt_preregistered_ref
         return adopt_preregistered_ref(c[0], tuple(c[1]))
-    if isinstance(c, tuple):
+    if isinstance(c, (tuple, list)):
         return ObjectID(c[0])
     return ObjectID(c)
 
@@ -112,7 +116,6 @@ class TaskManager:
     # -- submission --------------------------------------------------------
 
     def add_pending_task(self, spec: TaskSpec) -> None:
-        from ray_tpu._private.config import get_config
         with self._lock:
             prev = self._tasks.get(spec.task_id)
             if prev is None or prev.status in ("finished", "failed"):

@@ -472,6 +472,20 @@ class Worker:
         self._store_result(oid, entry)
 
     def _store_result(self, oid: ObjectID, entry: Entry) -> None:
+        if entry.kind == "blob" and not entry.contained:
+            # Hot path (small inline result, no captured refs): skip
+            # the shm-adoption probe and the containment bookkeeping.
+            self.memory_store.put(oid, entry)
+            with self._ready_cb_lock:
+                cbs = self._ready_callbacks.pop(oid, None)
+            for cb in cbs or ():
+                try:
+                    cb(oid)
+                except Exception:
+                    logger.exception("object-ready callback failed")
+            self.node_group.on_object_available(oid)
+            self._flush_actor_queues()
+            return
         if entry.kind == "shm" and not self.shm_store.contains(oid):
             # result written by a worker process: adopt the segment
             try:
@@ -625,20 +639,42 @@ class Worker:
         callback(oid)
 
     def _on_ref_zero(self, oid: ObjectID) -> None:
-        self.memory_store.free(oid)
-        self.shm_store.free(oid)
-        self.device_store.free(oid)
+        # Pop-and-inspect: inline (blob/err) entries — the common case
+        # for small task results — have no shm segment and no device
+        # residence, so the two extra store locks are skipped. An
+        # unknown or storage-backed entry takes the full sweep.
+        entry = self.memory_store.pop(oid)
+        kind = getattr(entry, "kind", None)
+        if kind not in ("blob", "err"):
+            self.shm_store.free(oid)
+            self.device_store.free(oid)
         self.task_manager.release_lineage(oid)
 
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
         owned = self._resolve_owned(refs, deadline)
+        # Fast pre-pass: one lock acquisition snapshots every already-
+        # completed entry, so a wave's get() doesn't pay a condition-
+        # variable round trip per ref (only stragglers block below).
+        ready = self.memory_store.get_ready(
+            [r.id() for r in refs if r.owner_addr() is None])
         out: List[Any] = []
         for i, ref in enumerate(refs):
             if ref.owner_addr() is not None:
                 out.append(owned[i])
                 continue
+            first = ready.get(ref.id())
+            if first is not None:
+                try:
+                    out.append(self._entry_value(ref.id(), first))
+                    continue
+                except _LostObjectSignal:
+                    if not self._recover_object(ref.id()):
+                        raise ObjectLostError(
+                            f"object {ref.id()} was lost and cannot be "
+                            "reconstructed (no lineage retained or "
+                            "reconstruction budget exhausted)") from None
             while True:
                 remaining = None
                 if deadline is not None:
@@ -1208,6 +1244,15 @@ class Worker:
                       for i in range(num_returns)]
         max_retries = (options.max_retries if options.max_retries is not None
                        else cfg.task_max_retries)
+        # The demand dict is a pure function of the options; cache it
+        # on the options object (remote_function reuses one TaskOptions
+        # per decorated function) so a tight .remote() loop builds it
+        # once, not once per call. Nothing mutates spec.resources, so
+        # a shallow copy per spec is safe.
+        demand = getattr(options, "_demand_cache", None)
+        if demand is None:
+            demand = options.resource_demand()
+            options._demand_cache = demand  # type: ignore[attr-defined]
         spec = TaskSpec(
             task_id=task_id,
             job_id=self.job_id,
@@ -1216,7 +1261,7 @@ class Worker:
             args=spec_args,
             kwargs_keys=kwargs_keys,
             num_returns=num_returns,
-            resources=options.resource_demand(),
+            resources=dict(demand),
             max_retries=max_retries,
             retry_exceptions=options.retry_exceptions,
             scheduling_strategy=options.scheduling_strategy,
@@ -1334,10 +1379,11 @@ class Worker:
         spec = rec.spec if rec else None
         if spec is not None:
             from ray_tpu._private import events
-            ok = err_blob is None and system_error is None
-            events.record(task_id.hex(), spec.repr_name(),
-                          "FINISHED" if ok else "FAILED",
-                          extra=timings)
+            if events.active():
+                ok = err_blob is None and system_error is None
+                events.record(task_id.hex(), spec.repr_name(),
+                              "FINISHED" if ok else "FAILED",
+                              extra=timings)
         if (spec is not None
                 and spec.task_type == TaskType.ACTOR_CREATION_TASK):
             self._on_actor_creation_done(spec, err_blob, system_error)
@@ -1561,7 +1607,11 @@ class Worker:
         info = self.gcs.get_actor_info(actor_id)
         if info is None:
             raise ValueError(f"unknown actor {actor_id}")
-        self._ensure_actor_route(actor_id, info)
+        if actor_id not in self._actor_specs:
+            # only actors created by ANOTHER driver (detached lookup)
+            # need a route built; our own actors got queue + route at
+            # create_actor — skipping the two-lock probe per call
+            self._ensure_actor_route(actor_id, info)
         task_id = TaskID.of(actor_id)
         spec_args: List[TaskArg] = []
         kwargs_keys = self.build_args(args, kwargs, spec_args)
@@ -1614,8 +1664,11 @@ class Worker:
     def _flush_actor_queues(self) -> None:
         # Signal the flusher thread instead of flushing inline: the
         # submitting thread keeps producing while the flusher drains
-        # whatever accumulated (adaptive batching).
-        self._actor_flush_wake.set()
+        # whatever accumulated (adaptive batching). is_set() first —
+        # it is lock-free, and this runs per completion as well as per
+        # submission (a redundant set() takes the event lock).
+        if not self._actor_flush_wake.is_set():
+            self._actor_flush_wake.set()
 
     def _actor_flush_loop(self) -> None:
         wake = self._actor_flush_wake

@@ -57,6 +57,9 @@ class BaseWorker:
         # stolen reply omits one, the owner falls through to the
         # interrupt path instead of trusting the miss (steal/exec race)
         self.cancel_steal_targets: dict = {}
+        # function_id -> template name already shipped to this worker
+        # (the exec-payload template strip; see node_manager._send_task)
+        self.exec_templates: dict = {}
 
     def send(self, msg: tuple) -> None:
         raise NotImplementedError
@@ -189,6 +192,8 @@ class InProcessWorker(BaseWorker):
                 self.env.dag_stages[msg[1]] = msg[2]
             elif op == "actor_tmpl":
                 self.env.actor_templates[msg[1]] = msg[2]
+            elif op == "exec_tmpl":
+                self.env.exec_templates[msg[1]] = msg[2]
             elif op == "cancel_actor_task":
                 self.env.cancel_actor_task(msg[1], msg[2])
             elif op in ("exec", "create_actor", "exec_actor",
@@ -345,9 +350,17 @@ class WorkerPool:
                     self._idle_process.append(worker)
         self._on_worker_ready()
 
+    _REAP_PERIOD_S = 0.1
+
     def _reap_dead(self) -> None:  # lock-held: _lock
         cfg = get_config()
         now = time.monotonic()
+        # Throttled: this runs on every lease attempt (per task at
+        # wave rates) but reaps on a ~100ms cadence; pop_worker's own
+        # alive checks already skip dead workers in between.
+        if now - getattr(self, "_last_reap", 0.0) < self._REAP_PERIOD_S:
+            return
+        self._last_reap = now
         for w in list(self._all.values()):
             if isinstance(w, ProcessWorker) and not w.ready:
                 if w.proc.poll() is not None or \
@@ -384,7 +397,13 @@ class WorkerPool:
             if not tagged:
                 del self._idle_tagged[tag]
 
-    PIPELINE_DEPTH = 8   # max queued normal tasks per leased worker
+    # Max queued normal tasks per leased worker. Sized with the
+    # data-plane batching in mind: the dispatch flush coalesces up to
+    # this many exec payloads into one pipe frame, and the worker's
+    # reply coalescer mirrors it on the way back; stalled pipes still
+    # rescue via the steal path, so depth costs latency only when the
+    # head task blocks — and then the rescue empties the pipe anyway.
+    PIPELINE_DEPTH = 32
 
     def pipeline_candidate(self) -> Optional[BaseWorker]:
         """A busy generic process worker with pipe headroom: normal

@@ -125,6 +125,11 @@ class ExecutionEnv:
         # registered when the actor worker is leased so the per-call
         # frame ships only the varying fields ("atmpl" key).
         self.actor_templates: Dict[bytes, dict] = {}
+        # Normal-task exec templates, keyed by function_id: the
+        # constant half of an exec payload, shipped once per worker so
+        # per-task frames carry only task_id/args/return_ids ("xt"
+        # key; see node_manager._send_task).
+        self.exec_templates: Dict[bytes, dict] = {}
         # actor_id -> its thread pool (max_concurrency>1 sync actors)
         self._pools: Dict[bytes, Any] = {}
         # actor_id -> _AsyncActorLoop (actors with async def methods)
@@ -152,6 +157,21 @@ class ExecutionEnv:
                     "num_returns": len(payload.get("return_ids", ())),
                     "kwargs_keys": [], "name": "compiled-dag-stage",
                     "_missing_stage": True}
+        return {**template, **payload}
+
+    def merge_exec(self, payload: dict) -> dict:
+        key = payload.get("xt")
+        if key is None:
+            return payload
+        template = self.exec_templates.get(key)
+        if template is None:
+            # Template never arrived (should be impossible — it rides
+            # the same FIFO pipe ahead of the first templated exec):
+            # fail the ONE task with an actionable error instead of
+            # KeyError-ing the worker loop.
+            return {**payload, "type": "exec", "kwargs_keys": [],
+                    "num_returns": len(payload.get("return_ids", ())),
+                    "name": "exec-task", "_missing_stage": True}
         return {**template, **payload}
 
     def merge_actor(self, payload: dict) -> dict:
@@ -210,7 +230,7 @@ class ExecutionEnv:
                 # it (FIFO pipe => a commit never outruns its results)
                 self._maybe_autosave(p.get("actor_id"), send)
             return
-        payload = self.merge_stage(self.merge_actor(body))
+        payload = self.merge_exec(self.merge_stage(self.merge_actor(body)))
         if op == "exec_actor":
             aid = payload.get("actor_id")
             aloop = self._aloops.get(aid)
@@ -255,6 +275,13 @@ class ExecutionEnv:
         from ray_tpu._private import actor_checkpoint as _ackpt
         rec["count"] = 0
         gen = rec["gen"] + 1
+        # Deferred-reply fence (see ExecutionEnv.execute): the
+        # triggering call's reply must be ON THE PIPE before
+        # __ray_save__ (user code, chaos-killable) runs — "completions
+        # precede the covering commit" assumes the completion ships.
+        flush = getattr(send, "flush_deferred", None)
+        if flush is not None:
+            flush()
         try:
             state = instance.__ray_save__()
             nbytes = _ackpt.save_generation(rec["root"], gen,
@@ -429,10 +456,21 @@ class ExecutionEnv:
         streaming generator tasks."""
         import time as _time
         from ray_tpu._private import chaos
+        # Deferred-reply fence: completed-but-buffered replies must
+        # reach the pipe BEFORE user code (which may crash the
+        # process) runs — pipe contents survive writer death, the
+        # coalescer's buffer does not. Without this, a kill at the
+        # next call's entry re-runs already-executed calls on replay
+        # (duplicate side effects).
+        flush = getattr(emit, "flush_deferred", None)
+        if flush is not None:
+            flush()
         # chaos kill-at-point: a `worker.exec.<task-name>:kill` rule
         # dies HERE — after the payload reached this worker, before any
         # user code ran (the mid-task worker-death failure mode).
-        chaos.fire("worker", "exec", payload.get("name", ""))
+        # armed-check inline: this is the per-task hot path.
+        if chaos._plane.armed:
+            chaos.fire("worker", "exec", payload.get("name", ""))
         task_id = payload["task_id"]
         t_start = _time.perf_counter()
         # Expose the owner channel + identity to nested API calls made
@@ -933,6 +971,75 @@ class _AsyncActorLoop:
             pass
 
 
+class _ReplyCoalescer:
+    """Worker-side completion batching: deferred replies ('done',
+    'stream') buffer under the send lock and ship as one
+    ('batch', [...]) frame — one pickle + one pipe write for a burst
+    of completions instead of one per task. Three flush triggers:
+
+    - size: ``worker_reply_flush_max`` buffered replies;
+    - idle: the main loop flushes when its intake runs dry (a serial
+      round trip pays ~zero added latency);
+    - deadline: a daemon flusher ships anything older than
+      ``worker_reply_flush_ms`` — the bound that makes deferral safe
+      even when a finished reply sits behind an arbitrarily slow
+      successor task (the failure mode that forbids coalescing
+      inline on the serial-actor execution path).
+
+    Urgent sends (control replies) flush the buffer ahead of
+    themselves, so the peer observes exactly the send order.
+    """
+
+    def __init__(self, conn, send_lock: threading.Lock):
+        from ray_tpu._private.config import get_config
+        cfg = get_config()
+        self._conn = conn
+        self._lock = send_lock
+        self._buf: list = []  # guarded-by: _lock (bounded by _max)
+        self._flush_s = max(0.0, cfg.worker_reply_flush_ms / 1000.0)
+        self._max = max(1, cfg.worker_reply_flush_max)
+        self._armed = threading.Event()
+        if self._flush_s > 0:
+            threading.Thread(target=self._deadline_loop, daemon=True,
+                             name="rtpu-worker-flush").start()
+
+    def send(self, reply, defer: bool = False) -> None:
+        if not defer or self._flush_s <= 0:
+            with self._lock:
+                self._flush_locked()
+                self._conn.send(reply)
+            return
+        with self._lock:
+            self._buf.append(reply)
+            if len(self._buf) >= self._max:
+                self._flush_locked()
+            elif len(self._buf) == 1:
+                self._armed.set()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:  # lock-held: _lock
+        buf = self._buf
+        if not buf:
+            return
+        self._buf = []
+        self._conn.send(buf[0] if len(buf) == 1 else ("batch", buf))
+
+    def _deadline_loop(self) -> None:
+        # no-deadline: daemon flusher; each pass blocks on the arm
+        # event, then bounds buffered replies' age by one flush window
+        while True:
+            self._armed.wait()
+            self._armed.clear()
+            time.sleep(self._flush_s)
+            try:
+                self.flush()
+            except (OSError, ValueError):
+                return      # pipe gone: the worker is shutting down
+
+
 def worker_main(conn, session: str, max_inline_bytes: int,
                 env_vars: Optional[dict] = None) -> None:
     """Message loop of a process worker (conn already registered).
@@ -970,10 +1077,21 @@ def worker_main(conn, session: str, max_inline_bytes: int,
     worker_core.configure(session, max_inline_bytes)
     env = ExecutionEnv(session, max_inline_bytes)
     send_lock = threading.Lock()
+    coalescer = _ReplyCoalescer(conn, send_lock)
 
+    # Completion coalescing (data-plane fast path, layer 2, worker
+    # half): 'done'/'stream' replies buffer and leave as one
+    # ('batch', ...) frame — flushed when the intake runs dry, the
+    # deadline passes, or the buffer fills. Control replies (stolen,
+    # actor_ready, ...) flush the buffer ahead of themselves, so
+    # global reply order is exactly the send order.
     def send(reply) -> None:
-        with send_lock:
-            conn.send(reply)
+        coalescer.send(reply, defer=reply[0] in ("done", "stream"))
+
+    # Pre-user-code fence consulted by ExecutionEnv (execute /
+    # save_actor_checkpoint): deferral must never hold a completed
+    # reply across a crashable user-code boundary.
+    send.flush_deferred = coalescer.flush
 
     # On-demand stack dumps MUST work while the loop thread is busy
     # executing a task (that is when you want them), so the request
@@ -1170,6 +1288,12 @@ def worker_main(conn, session: str, max_inline_bytes: int,
                 if conn_closed[0]:
                     break
                 try:
+                    # intake ran dry: ship whatever completions are
+                    # buffered before blocking (the idle-flush trigger)
+                    coalescer.flush()
+                except (OSError, ValueError):
+                    break       # pipe gone: owner hung up
+                try:
                     inbox_evt.wait(timeout=1.0)
                     inbox_evt.clear()
                 except KeyboardInterrupt:
@@ -1187,6 +1311,8 @@ def worker_main(conn, session: str, max_inline_bytes: int,
                 env.dag_stages[msg[1]] = msg[2]
             elif op == "actor_tmpl":
                 env.actor_templates[msg[1]] = msg[2]
+            elif op == "exec_tmpl":
+                env.exec_templates[msg[1]] = msg[2]
             elif op in ("exec", "create_actor", "exec_actor",
                         "exec_actor_batch"):
                 try:
@@ -1217,6 +1343,12 @@ def worker_main(conn, session: str, max_inline_bytes: int,
             elif op == "ping":
                 send(("pong",))
     finally:
+        try:
+            # graceful shutdown: completed-but-buffered replies must
+            # reach the owner before the pipe closes
+            coalescer.flush()
+        except Exception:
+            pass    # pipe already gone: owner handles via worker death
         env.shutdown_exec()
         env.shm_client.close()
         core = worker_core.try_worker_core()

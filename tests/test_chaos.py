@@ -700,3 +700,270 @@ def test_node_killed_mid_task_reconstructs_exactly_once(tmp_path):
         os.environ.pop(chaos.ENV_VAR, None)
         cluster.shutdown()
         get_config().reset()
+
+
+# ---------------------------------------------------------------------------
+# data-plane fast path (docs/data_plane.md): chaos on COALESCED frames.
+# The batching layers (submit_many gather window, task_done_many
+# completion coalescing) must inherit PR-2's contract unchanged: a
+# dropped/duplicated/severed frame costs latency, never results —
+# exactly-once execution, per-caller completion order, and per-payload
+# shed statuses all survive the frames carrying N tasks instead of 1.
+
+
+def test_severed_coalesced_submit_many_executes_exactly_once(tmp_path):
+    """Sever the first coalesced submit_many frame mid-send: the
+    retrying channel reconnects and re-sends under the SAME
+    idempotency token, so every payload in the frame executes exactly
+    once and nothing is lost or doubled."""
+    ray_tpu.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu._private.config import get_config
+
+    marker = tmp_path / "ran.txt"
+    # a generous gather window makes the burst leave as ONE frame
+    cluster = Cluster(head_num_cpus=2,
+                      _system_config={"submit_coalesce_ms": 20.0})
+    try:
+        cluster.add_node(num_cpus=4, resources={"B": 4}, remote=True,
+                         max_process_workers=2)
+
+        @ray_tpu.remote(num_cpus=0, resources={"B": 0.01})
+        def burst(path, i):
+            with open(path, "a") as f:
+                f.write(f"{i}\n")
+            return i
+
+        chaos.install("raylet_channel.send.submit_many:sever@1")
+        refs = [burst.remote(str(marker), i) for i in range(16)]
+        assert ray_tpu.get(refs, timeout=120) == list(range(16))
+        # the fault really hit a COALESCED frame (vacuity guard)
+        assert ("raylet_channel", "send", "submit_many",
+                "sever") in chaos.events()
+        ran = sorted(int(x) for x in marker.read_text().split())
+        assert ran == list(range(16))     # exactly once each
+        # wire-level retry, not task retry: the frame never reached
+        # the raylet, so nothing ran twice and nothing was failed
+        assert cluster.worker.task_manager.num_retries == 0
+    finally:
+        cluster.shutdown()
+        get_config().reset()
+
+
+def test_duplicated_coalesced_submit_many_executes_exactly_once(tmp_path):
+    """Double a coalesced submit_many frame on the wire: the server's
+    dedupe cache collapses the duplicate CALL to one execution for
+    every payload, and the hit is observable in the raylet's
+    heartbeat (dedupe hit-rate satellite)."""
+    ray_tpu.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu._private.config import get_config
+
+    marker = tmp_path / "ran.txt"
+    cluster = Cluster(head_num_cpus=2,
+                      _system_config={"submit_coalesce_ms": 20.0})
+    try:
+        nid = cluster.add_node(num_cpus=4, resources={"B": 4},
+                               remote=True, max_process_workers=2)
+
+        @ray_tpu.remote(num_cpus=0, resources={"B": 0.01})
+        def burst(path, i):
+            with open(path, "a") as f:
+                f.write(f"{i}\n")
+            return i
+
+        chaos.install("raylet_channel.send.submit_many:dup@1")
+        refs = [burst.remote(str(marker), i) for i in range(16)]
+        assert ray_tpu.get(refs, timeout=120) == list(range(16))
+        assert ("raylet_channel", "send", "submit_many",
+                "dup") in chaos.events()
+        ran = sorted(int(x) for x in marker.read_text().split())
+        assert ran == list(range(16))     # dedupe collapsed the dup
+        # the dedupe hit surfaces in the raylet's heartbeat stats
+        w = cluster.worker
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            entry = w.node_stats.get(nid)
+            if entry and entry[1].get("dedupe_hits", 0) >= 1:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                "duplicate frame's dedupe hit never surfaced in "
+                f"heartbeat stats: {w.node_stats.get(nid)}")
+        assert entry[1].get("dedupe_hit_rate", 0.0) > 0.0
+    finally:
+        cluster.shutdown()
+        get_config().reset()
+
+
+def test_severed_task_done_many_replays_exactly_once_in_order():
+    """Sever the first coalesced task_done_many completion frame on
+    the raylet side: the payloads land in the PR-2 replay buffer, the
+    owner's retrying channel reconnects + re-registers, and the
+    replayed completions arrive exactly once in per-caller order (the
+    counter's strictly increasing returns prove both)."""
+    ray_tpu.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu._private.config import get_config
+
+    # rule rides the env into the spawned raylet process; popped
+    # right after spawn so nothing else arms it
+    os.environ[chaos.ENV_VAR] = "raylet.send.task_done_many:sever@1"
+    cluster = Cluster(head_num_cpus=2,
+                      _system_config={"task_done_coalesce_ms": 20.0})
+    try:
+        nid = cluster.add_node(num_cpus=2, resources={"S": 2},
+                               remote=True, max_process_workers=1)
+        os.environ.pop(chaos.ENV_VAR, None)
+
+        @ray_tpu.remote(num_cpus=0, resources={"S": 0.01})
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        a = Counter.remote()
+        refs = [a.inc.remote() for _ in range(30)]
+        # exactly-once AND ordered: a doubled call would break the
+        # 1..30 sequence, a lost completion would hang the get
+        assert ray_tpu.get(refs, timeout=120) == list(range(1, 31))
+        w = cluster.worker
+        handle = w.node_group._remote_nodes[nid]
+        # the sever really fired (the rule only matches a COALESCED
+        # completion frame) and cost one reconnect, nothing else
+        deadline = time.monotonic() + 10
+        while (handle.client.num_reconnects < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        assert handle.client.num_reconnects >= 1
+        assert w.task_manager.num_retries == 0
+    finally:
+        os.environ.pop(chaos.ENV_VAR, None)
+        cluster.shutdown()
+        get_config().reset()
+
+
+def test_shed_statuses_in_coalesced_frame_honored_per_payload(tmp_path):
+    """A burst bigger than the raylet's bounded intake leaves as one
+    coalesced submit_many frame whose reply mixes admitted and shed
+    statuses: the owner honors each PER PAYLOAD — shed tasks retry
+    after backoff, admitted tasks run once, nothing is lost."""
+    ray_tpu.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu._private.config import get_config
+
+    marker = tmp_path / "ran.txt"
+    cluster = Cluster(head_num_cpus=2, _system_config={
+        "submit_coalesce_ms": 20.0,
+        "raylet_max_queued_tasks": 4,
+        "backpressure_retry_base_ms": 20,
+        "backpressure_retry_max_ms": 200,
+    })
+    try:
+        cluster.add_node(num_cpus=4, resources={"B": 4}, remote=True,
+                         max_process_workers=2)
+
+        @ray_tpu.remote(num_cpus=0, resources={"B": 0.01})
+        def burst(path, i):
+            time.sleep(0.05)
+            with open(path, "a") as f:
+                f.write(f"{i}\n")
+            return i
+
+        refs = [burst.remote(str(marker), i) for i in range(16)]
+        assert ray_tpu.get(refs, timeout=120) == list(range(16))
+        ran = sorted(int(x) for x in marker.read_text().split())
+        assert ran == list(range(16))     # exactly once each
+        w = cluster.worker
+        # the burst hit the bounded intake through coalesced frames:
+        # sheds were honored per payload (not whole-frame requeues)
+        assert w.node_group.num_shed > 0
+        lease = w.node_group.wire_stats.channel("lease_rpc")
+        assert lease.payloads > lease.frames   # >=1 frame carried >1
+        assert w.task_manager.num_retries == 0
+    finally:
+        cluster.shutdown()
+        get_config().reset()
+
+
+def test_wire_plane_gauges_move_under_batched_workload():
+    """Observability satellite: ray_tpu_rpc_batch_size{channel},
+    ray_tpu_rpc_fastframe_hits, and the per-node heartbeat wire stats
+    all move when a batched workload runs."""
+    ray_tpu.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu._private.config import get_config
+
+    cluster = Cluster(head_num_cpus=2,
+                      _system_config={"submit_coalesce_ms": 20.0})
+    try:
+        nid = cluster.add_node(num_cpus=4, resources={"B": 4},
+                               remote=True, max_process_workers=2)
+
+        @ray_tpu.remote(num_cpus=0, resources={"B": 0.01})
+        def f(i):
+            return i
+
+        assert ray_tpu.get([f.remote(i) for i in range(64)],
+                           timeout=120) == list(range(64))
+        w = cluster.worker
+        # wait one heartbeat so the raylet's wire sub-dict arrives
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            entry = w.node_stats.get(nid)
+            if entry and isinstance(entry[1].get("wire"), dict):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("heartbeat never carried wire stats")
+
+        from ray_tpu.util import metrics
+        text = metrics.prometheus_text()
+        batch_lines = [ln for ln in text.splitlines()
+                       if ln.startswith("ray_tpu_rpc_batch_size")]
+        assert any('channel="lease_rpc"' in ln and
+                   float(ln.split()[-1]) > 1.0 for ln in batch_lines), \
+            batch_lines
+        ff_lines = [ln for ln in text.splitlines()
+                    if ln.startswith("ray_tpu_rpc_fastframe_hits")
+                    and not ln.startswith("#")]
+        assert ff_lines and float(ff_lines[0].split()[-1]) > 0
+    finally:
+        cluster.shutdown()
+        get_config().reset()
+
+
+def test_fastframe_preserves_worker_owned_contained_refs():
+    """Regression: a worker-owned contained ref rides the completion
+    push as a (bytes, owner_addr) pair; on the negotiated binary
+    small-frame path msgpack normalizes the pair to a LIST, and the
+    owner's containment adoption must accept both spellings — the
+    original tuple-only gate crashed the push handler, hanging the
+    get() and leaking the pre-registered borrow."""
+    ray_tpu.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_num_cpus=1)
+    try:
+        cluster.add_node(num_cpus=2, resources={"V": 2}, remote=True,
+                         max_process_workers=1)
+
+        @ray_tpu.remote(num_cpus=0, resources={"V": 0.01})
+        def maker():
+            inner = ray_tpu.put("worker-owned-value")
+            return {"ref": inner}
+
+        out = ray_tpu.get(maker.remote(), timeout=60)
+        assert ray_tpu.get(out["ref"],
+                           timeout=60) == "worker-owned-value"
+        # the small result really rode the fast path (vacuity guard)
+        from ray_tpu._private import wire_stats
+        snap = wire_stats.snapshot()
+        assert snap.get("rpcin:raylet_channel",
+                        {}).get("fastframe_hits", 0) > 0
+    finally:
+        cluster.shutdown()

@@ -18,7 +18,12 @@ def _read_jsonl(path):
 
 def test_event_export_and_usage_stats():
     ray_tpu.shutdown()
-    w = ray_tpu.init(num_cpus=4, max_process_workers=2)
+    from ray_tpu._private.config import get_config
+
+    # export is opt-in since the data-plane fast path (the TASK
+    # stream costs two records per task on the hot path)
+    w = ray_tpu.init(num_cpus=4, max_process_workers=2,
+                     _system_config={"event_export_enabled": True})
     export_dir = os.path.join("/tmp", f"rtpu_{w.session}", "export")
 
     @ray_tpu.remote
@@ -35,6 +40,7 @@ def test_event_export_and_usage_stats():
     assert ray_tpu.get(a.ping.remote()) == "pong"
     session = w.session
     ray_tpu.shutdown()     # flushes the export buffers
+    get_config().reset()   # event_export_enabled must not leak
 
     task_events = _read_jsonl(os.path.join(export_dir,
                                            "event_TASK.jsonl"))
@@ -58,13 +64,24 @@ def test_event_export_and_usage_stats():
     assert usage["actors_registered"] >= 1
 
 
-def test_node_membership_export(ray_start_cluster):
-    cluster = ray_start_cluster
-    w = cluster._worker
-    export_dir = os.path.join("/tmp", f"rtpu_{w.session}", "export")
-    node_id = cluster.add_node(num_cpus=1, remote=True)
-    from ray_tpu._private import export
-    export._writer.flush()
-    events = _read_jsonl(os.path.join(export_dir, "event_NODE.jsonl"))
-    assert any(e.get("event") == "ADDED"
-               and e.get("node_id") == node_id.hex() for e in events)
+def test_node_membership_export():
+    ray_tpu.shutdown()
+    from ray_tpu._private.config import get_config
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_num_cpus=4, _system_config={
+        "event_export_enabled": True})
+    try:
+        w = cluster.worker
+        export_dir = os.path.join("/tmp", f"rtpu_{w.session}", "export")
+        node_id = cluster.add_node(num_cpus=1, remote=True)
+        from ray_tpu._private import export
+        export._writer.flush()
+        events = _read_jsonl(os.path.join(export_dir,
+                                          "event_NODE.jsonl"))
+        assert any(e.get("event") == "ADDED"
+                   and e.get("node_id") == node_id.hex()
+                   for e in events)
+    finally:
+        cluster.shutdown()
+        get_config().reset()

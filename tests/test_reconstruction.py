@@ -131,14 +131,24 @@ def test_reconstruction_after_actor_checkpoint_restore(tmp_path):
 
         # Arm BEFORE any worker spawns (a pre-spawned unarmed worker
         # would be reused for the actor): die at the 2nd `use` exec.
-        # The rule is method-specific, so a second worker picking it
-        # up is harmless — `make` never matches it.
+        # The armed window is confined to EXACTLY ONE worker spawn by
+        # capping the pool: dispatch retries during actor creation
+        # spawn ahead, and a second worker spawned while the env rule
+        # is set would stay armed — the RESTARTED actor landing on it
+        # replays one `use` and the next call is that process's @2
+        # trigger again, burning the restart budget (flaky kill #2).
+        pool = w.node_group._raylets[
+            w.node_group.head_node_id].worker_pool
+        with pool._lock:
+            pool._max_process = 1
         os.environ[chaos.ENV_VAR] = "worker.exec.Summer.use:kill@2"
         try:
             a = Summer.remote()
             assert ray_tpu.get(a.ping.remote(), timeout=60) == "up"
         finally:
             os.environ.pop(chaos.ENV_VAR, None)
+            with pool._lock:
+                pool._max_process = 2
         data = make.remote()
         ray_tpu.get(data)
         assert ray_tpu.get(a.use.remote(data), timeout=60) == (3, 1)
