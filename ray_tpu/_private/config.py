@@ -120,6 +120,16 @@ _D("tpu_scheduler_min_batch", int, 64,
 _D("pg_kernel_min_work", int, 4096,
    "bundles x nodes product above which placement-group packing uses "
    "the jitted assignment kernel (accelerator hosts only).")
+_D("pg_pack_topk", int, 128,
+   "Candidate nodes per group in the batched gang-packing kernel's "
+   "top-k pre-filter (raised to the group's bundle count, capped at "
+   "the cluster size). Groups that don't fit their candidate set "
+   "fall back to the full single-group solve.")
+_D("scheduler_fence_enabled", bool, True,
+   "Park capacity-fenced scheduling classes (batch count beyond the "
+   "node-totals capacity bound) in the owner's unplaceable ledger, "
+   "released on the next cluster resource-version delta, instead of "
+   "rescanning them every tick. Off = legacy retry-every-tick.")
 _D("use_tpu_scheduler", str, "auto",
    "Select the TPU policy in the ISchedulingPolicy registry: "
    "'auto' (default) uses it whenever an accelerator backend is "

@@ -39,11 +39,29 @@ from ray_tpu._private.task_spec import TaskSpec, TaskType
 from ray_tpu._private.worker_pool import BaseWorker, ProcessWorker, WorkerPool
 from ray_tpu.exceptions import (
     BackpressureError,
+    CapacityInfeasibleError,
     OutOfMemoryError,
     WorkerCrashedError,
 )
 
 logger = logging.getLogger(__name__)
+
+
+class _FencedClass:
+    """One scheduling class parked in the unplaceable ledger
+    (docs/scheduler.md): its pending count exceeds the cluster's
+    node-totals capacity bound, so rescanning it every tick is pure
+    waste. ``version`` is the cluster resource version at park time —
+    the scheduling loop releases the class back into scheduling on the
+    first version delta (capacity freed, node joined/left), which is
+    the only way new room can appear."""
+
+    __slots__ = ("version", "specs", "error")
+
+    def __init__(self, version: int, error: CapacityInfeasibleError):
+        self.version = version
+        self.specs: List[TaskSpec] = []
+        self.error = error
 
 
 class DependencyManager:
@@ -259,6 +277,14 @@ class NodeManagerGroup:
         # local driver's own burst is its own flow control
         self._to_schedule: deque = deque()  # guarded-by: _lock
         self._infeasible: Dict[TaskID, TaskSpec] = {}  # guarded-by: _lock
+        # Unplaceable-class ledger (docs/scheduler.md): capacity-fenced
+        # scheduling classes parked until the cluster resource version
+        # moves. Keyed by the class's sorted demand items.
+        self._unplaceable: Dict[tuple, _FencedClass] = {}  # guarded-by: _lock
+        self.num_fenced = 0   # fenced parks honored (cumulative)
+        # unbounded-ok: one entry per distinct fenced demand shape,
+        # only used to rate-limit the first-fence warning/export
+        self._fence_warned: set = set()
         self._running: Dict[TaskID, RunningTask] = {}  # guarded-by: _lock
         self._actor_workers: Dict[ActorID, Tuple[NodeID, BaseWorker, dict]] = {}  # guarded-by: _lock
         self._actor_death_cb: Optional[Callable] = None
@@ -1374,6 +1400,20 @@ class NodeManagerGroup:
             if spec is None:
                 spec = self._infeasible.pop(task_id, None)
             if spec is None:
+                # parked in the unplaceable (capacity-fence) ledger:
+                # holds no allocation, removal is the cancellation
+                for key, entry in list(self._unplaceable.items()):
+                    for q_spec in entry.specs:
+                        if q_spec.task_id == task_id:
+                            entry.specs.remove(q_spec)
+                            entry.error.pending = len(entry.specs)
+                            if not entry.specs:
+                                del self._unplaceable[key]
+                            spec = q_spec
+                            break
+                    if spec is not None:
+                        break
+            if spec is None:
                 # parked in the overload plane's deferred queue (shed
                 # backoff / OOM retry): it holds no allocation, so
                 # removal is the whole cancellation
@@ -1522,6 +1562,10 @@ class NodeManagerGroup:
                     self.pg_manager.try_schedule_pending()
                 # shed/OOM'd specs whose backoff expired rejoin here
                 self._pump_deferred()
+                # capacity-fenced classes rejoin only after the
+                # cluster ledger moved (docs/scheduler.md): a static
+                # tick never rescans them
+                self._release_unplaceable()
                 # Cap the batch at roughly what can place right now:
                 # at queue depth, re-scanning the ENTIRE backlog on
                 # every capacity change made each tick O(backlog) in
@@ -1786,6 +1830,7 @@ class NodeManagerGroup:
         if not batch:
             return 0
         retry: List[TaskSpec] = []
+        fenced: List[Tuple[TaskSpec, Optional[int]]] = []
         plain: List[TaskSpec] = []
         for spec in batch:
             if (spec.placement_group_id is not None
@@ -1808,6 +1853,14 @@ class NodeManagerGroup:
                 )
                 spec._sched_request = req   # type: ignore[attr-defined]
             requests.append(req)
+        # Park version captured BEFORE the policy call (and so before
+        # this tick's allocations and dispatches): any cluster
+        # mutation after this point — a node joining mid-batch, a
+        # completion's free() racing an allocation below — lands
+        # after the park version and releases the ledger next tick (a
+        # spurious release/re-fence is benign; a mutation swallowed
+        # into the park version is a permanently parked task).
+        fence_version = self.cluster_resources.version()
         results = self._policy.schedule_batch(
             self.cluster_resources, requests) if requests else []
         # Remote dispatches coalesce into ONE lease RPC per raylet per
@@ -1816,6 +1869,7 @@ class NodeManagerGroup:
         # the network.
         remote_batches: Dict[NodeID, Tuple[RemoteNodeHandle,
                                            List[TaskSpec]]] = {}
+        fence_on = get_config().scheduler_fence_enabled
         for spec, res in zip(batch, results):
             if res.node_id is None:
                 if res.is_infeasible:
@@ -1824,6 +1878,8 @@ class NodeManagerGroup:
                     logger.warning(
                         "task %s is infeasible: demand=%s",
                         spec.repr_name(), spec.resources)
+                elif res.is_fenced and fence_on:
+                    fenced.append((spec, res.fence_bound))
                 else:
                     retry.append(spec)
                 continue
@@ -1850,10 +1906,12 @@ class NodeManagerGroup:
                 raylet.dispatch_queue.append(spec)
         for handle, specs in remote_batches.values():
             self._dispatch_remote_batch(handle, specs)
+        if fenced:
+            self._fence_specs(fenced, fence_version)
         if retry:
             with self._lock:
                 self._to_schedule.extend(retry)
-        return max(0, len(batch) - len(retry))
+        return max(0, len(batch) - len(retry) - len(fenced))
 
     def pending_resource_demand(self) -> List[Dict[str, float]]:
         """Resource shapes of tasks the cluster cannot currently place
@@ -1863,6 +1921,8 @@ class NodeManagerGroup:
         with self._lock:
             demands.extend(dict(s.resources)
                            for s in self._infeasible.values())
+            for entry in self._unplaceable.values():
+                demands.extend(dict(s.resources) for s in entry.specs)
             demands.extend(dict(s.resources) for s in self._to_schedule)
         if self.pg_manager is not None:
             with self.pg_manager._lock:
@@ -1877,7 +1937,121 @@ class NodeManagerGroup:
             specs = list(self._infeasible.values())
             self._infeasible.clear()
             self._to_schedule.extend(specs)
+            for entry in self._unplaceable.values():
+                self._to_schedule.extend(entry.specs)
+            self._unplaceable.clear()
         self._wake.set()
+
+    # -- unplaceable-class ledger (capacity fence) ------------------------
+
+    def _class_capacity_bound(self, demand: Dict[str, float]) -> int:
+        """How many instances of ``demand`` the cluster's node TOTALS
+        could hold concurrently (the fence's typed-signal bound);
+        semantics single-sourced in policy.class_capacity_bound."""
+        from ray_tpu._private.scheduler.policy import class_capacity_bound
+        return class_capacity_bound(
+            ((node.total, node.alive)
+             for _nid, node in self.cluster_resources.nodes()), demand)
+
+    def _fence_specs(self, specs: List[Tuple[TaskSpec, Optional[int]]],
+                     version: int) -> None:
+        """Park capacity-fenced (spec, bound) pairs in the unplaceable
+        ledger and surface the typed signal: one
+        ``CapacityInfeasibleError`` per class (PR-3 overload taxonomy —
+        retryable, shipped typed over RPC), readable via
+        ``unplaceable_report`` and exported as the
+        ``ray_tpu_tasks{state=infeasible}`` gauge + the heartbeat's
+        ``unplaceable`` stat. ``version`` is the cluster resource
+        version from BEFORE the tick's own allocations (see
+        _schedule_once) so no concurrent free() can be swallowed; the
+        bound rides along from the policy (which already computed it)
+        so a saturated class's once-per-completion re-fence doesn't
+        pay an O(nodes) recompute."""
+        from ray_tpu._private import export
+        new_classes = []
+        recompute = []
+        with self._lock:
+            for spec, bound in specs:
+                key = tuple(sorted(spec.resources.items()))
+                entry = self._unplaceable.get(key)
+                if entry is None:
+                    entry = _FencedClass(version, CapacityInfeasibleError(
+                        f"demand {dict(spec.resources)} exceeds cluster "
+                        "capacity; parked until the resource ledger "
+                        "moves", demand=spec.resources,
+                        bound=bound if bound is not None else 0))
+                    self._unplaceable[key] = entry
+                    new_classes.append(entry)
+                    if bound is None:
+                        recompute.append(entry)
+                entry.version = version
+                entry.specs.append(spec)
+                entry.error.pending = len(entry.specs)
+                self.num_fenced += 1
+        for entry in recompute:
+            # bound computed outside _lock: it scans the cluster ledger
+            entry.error.bound = self._class_capacity_bound(
+                entry.error.demand)
+        for entry in new_classes:
+            # A saturated queue re-fences its class once per release
+            # cycle (every completion) — warn/export only the first
+            # time per class so steady-state saturation isn't noisy.
+            key = tuple(sorted(entry.error.demand.items()))
+            if key in self._fence_warned:
+                continue
+            self._fence_warned.add(key)
+            logger.warning(
+                "scheduling class %s fenced: cluster capacity bound %d "
+                "< pending; parked until capacity changes",
+                entry.error.demand, entry.error.bound)
+            export.emit("SCHED", {
+                "event": "CLASS_FENCED",
+                "demand": dict(entry.error.demand),
+                "bound": entry.error.bound,
+                "pending": entry.error.pending,
+            })
+
+    def _release_unplaceable(self) -> None:
+        """Fenced classes rejoin scheduling only after the cluster
+        resource version moved — capacity can only appear through a
+        ledger mutation (completion free, node join/leave), so static
+        ticks provably skip them (no per-tick rescan)."""
+        with self._lock:
+            if not self._unplaceable:
+                return
+            version = self.cluster_resources.version()
+            stale = [k for k, e in self._unplaceable.items()
+                     if e.version != version]
+            for key in stale:
+                entry = self._unplaceable.pop(key)
+                self._to_schedule.extend(entry.specs)
+
+    def unplaceable_report(self) -> List[dict]:
+        """Typed per-class view of everything the cluster cannot
+        currently hold, for the owner (autoscaler hints, dashboards,
+        tests): capacity-fenced classes (bound > 0 — surplus beyond
+        the totals bound) AND totals-infeasible classes (bound == 0 —
+        no node could EVER run one instance), each carrying its
+        ``CapacityInfeasibleError``."""
+        with self._lock:
+            out = [{"demand": dict(k), "pending": len(e.specs),
+                    "bound": e.error.bound, "error": e.error}
+                   for k, e in self._unplaceable.items()]
+            infeas: Dict[tuple, int] = {}
+            for spec in self._infeasible.values():
+                key = tuple(sorted(spec.resources.items()))
+                infeas[key] = infeas.get(key, 0) + 1
+        for key, pending in infeas.items():
+            out.append({
+                "demand": dict(key), "pending": pending, "bound": 0,
+                "error": CapacityInfeasibleError(
+                    f"demand {dict(key)} is infeasible on every node",
+                    demand=dict(key), bound=0, pending=pending)})
+        return out
+
+    def unplaceable_size(self) -> int:
+        with self._lock:
+            return sum(len(e.specs) for e in self._unplaceable.values())
 
     # -- dispatch ----------------------------------------------------------
 
@@ -2393,9 +2567,12 @@ class NodeManagerGroup:
                 "waiting_deps": len(self._waiting),
                 "running": len(self._running),
                 "infeasible": len(self._infeasible),
+                "unplaceable": sum(len(e.specs)
+                                   for e in self._unplaceable.values()),
                 "actors": len(self._actor_workers),
                 "deferred": len(self._deferred),
                 "shed": self.num_shed,
+                "fenced": self.num_fenced,
                 "window_waits": self.num_window_waits,
             }
 

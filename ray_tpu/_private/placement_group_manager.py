@@ -74,6 +74,7 @@ class PlacementGroupManager:
         self._pending: List[PlacementGroupID] = []
         self._kernel_solver = None   # lazy jitted bin-packer
         self.num_kernel_solves = 0
+        self.num_batched_solves = 0  # multi-group launches (storms)
 
     # -- creation / removal ------------------------------------------------
 
@@ -127,35 +128,81 @@ class PlacementGroupManager:
     # -- scheduling --------------------------------------------------------
 
     def try_schedule_pending(self) -> None:
-        """Attempt to place every pending group (all-or-nothing each)."""
+        """Attempt to place every pending group (all-or-nothing each).
+
+        When several groups of one strategy are pending at once — a
+        PR-4 restart storm re-creating gangs, a PR-6 slice-set re-form
+        — and the kernel path is on, they pack in ONE batched launch
+        (``PgKernelSolver.solve_many``); groups the batched solve
+        can't fit (or whose commit loses a race) fall back to the
+        single-group path below, which also owns infeasibility
+        marking."""
         with self._lock:
             pending = list(self._pending)
+        batched = self._try_schedule_batched(pending)
         for pg_id in pending:
+            if pg_id in batched:
+                continue
             with self._lock:
                 info = self._groups.get(pg_id)
                 if info is None or info.state != "PENDING":
                     continue
             self._try_place(info)
 
+    def _try_schedule_batched(self, pending) -> set:
+        """One kernel launch per pending strategy cohort; returns the
+        pg_ids successfully COMMITTED (the rest retry singly)."""
+        placed: set = set()
+        with self._lock:
+            cohorts: Dict[str, List[PlacementGroupInfo]] = {}
+            for pg_id in pending:
+                info = self._groups.get(pg_id)
+                if info is not None and info.state == "PENDING":
+                    cohorts.setdefault(info.strategy, []).append(info)
+        for strategy, infos in cohorts.items():
+            if len(infos) < 2:
+                continue
+            solver = self._kernel_for(
+                sum(len(i.bundles) for i in infos))
+            if solver is None:
+                continue
+            try:
+                assignments = solver.solve_many(
+                    self._cluster, [i.bundles for i in infos], strategy)
+            except Exception:
+                logger.exception("batched pg kernel solve failed; "
+                                 "single-group fallback")
+                continue
+            self.num_batched_solves += 1
+            for info, assignment in zip(infos, assignments):
+                if assignment is not None and self._commit(info,
+                                                           assignment):
+                    placed.add(info.pg_id)
+        return placed
+
     def _try_place(self, info: PlacementGroupInfo) -> None:
         assignment = self._solve(info)
         if assignment is None:
             return
-        # Commit: allocate each bundle from its node, rolling back on any
-        # conflict with a concurrent allocation (2-phase analogue).
+        self._commit(info, assignment)
+
+    def _commit(self, info: PlacementGroupInfo,
+                assignment: List[NodeID]) -> bool:
+        """Allocate each bundle from its node, rolling back on any
+        conflict with a concurrent allocation (2-phase analogue)."""
         committed: List[Tuple[NodeID, ResourceRequest]] = []
         for node_id, bundle in zip(assignment, info.bundles):
             if not self._cluster.allocate(node_id, bundle):
                 for nid, b in committed:
                     self._cluster.free(nid, b)
-                return
+                return False
             committed.append((node_id, bundle))
         with self._lock:
             if info.state != "PENDING":
                 # removed concurrently: roll the commit back
                 for nid, b in committed:
                     self._cluster.free(nid, b)
-                return
+                return False
             info.bundle_nodes = list(assignment)
             info.bundle_avail = [dict(b) for b in info.bundles]
             info.state = "CREATED"
@@ -166,26 +213,36 @@ class PlacementGroupManager:
                 self._on_created(info)
             except Exception:
                 logger.exception("pg on_created callback failed")
+        return True
+
+    def _kernel_for(self, n_bundles: int):
+        """The lazily-built jitted solver when the kernel path is on
+        and ``bundles × nodes`` crosses the work threshold; None
+        defers to the Python paths."""
+        from ray_tpu._private.config import get_config
+        work = n_bundles * self._cluster.num_nodes()
+        if work < get_config().pg_kernel_min_work:
+            return None
+        from ray_tpu._private.scheduler.policy import _tpu_scheduler_enabled
+        if not _tpu_scheduler_enabled():
+            return None
+        if self._kernel_solver is None:
+            from ray_tpu._private.scheduler.pg_kernel import (
+                PgKernelSolver)
+            self._kernel_solver = PgKernelSolver()
+        return self._kernel_solver
 
     def _try_kernel_solve(self, info: PlacementGroupInfo
                           ) -> Optional[List[NodeID]]:
         """The jitted assignment solve (BASELINE.json:5) for big
         bundle × node products on accelerator hosts; None defers to
         the Python greedy (which also owns infeasibility marking)."""
-        from ray_tpu._private.config import get_config
-        work = len(info.bundles) * self._cluster.num_nodes()
-        if work < get_config().pg_kernel_min_work:
-            return None
-        from ray_tpu._private.scheduler.policy import _tpu_scheduler_enabled
-        if not _tpu_scheduler_enabled():
+        solver = self._kernel_for(len(info.bundles))
+        if solver is None:
             return None
         try:
-            if self._kernel_solver is None:
-                from ray_tpu._private.scheduler.pg_kernel import (
-                    PgKernelSolver)
-                self._kernel_solver = PgKernelSolver()
-            return self._kernel_solver.solve(self._cluster, info.bundles,
-                                             info.strategy)
+            return solver.solve(self._cluster, info.bundles,
+                                info.strategy)
         except Exception:
             logger.exception("pg kernel solve failed; python fallback")
             return None

@@ -20,7 +20,9 @@ from ray_tpu._private.scheduler.policy import (
     ISchedulingPolicy,
     SchedulingRequest,
     SchedulingResult,
+    apply_capacity_fence,
     register_policy,
+    request_class_key,
 )
 from ray_tpu._private.scheduler.resources import ClusterResourceManager
 
@@ -140,13 +142,14 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
         cache, is the authority for commits."""
         self._sync(cluster)
         res_index = self._res_index
-        for k in request.demand:
-            if k not in res_index:
+        for k, v in request.demand.items():
+            if v > 0 and k not in res_index:
                 return SchedulingResult(None, is_infeasible=True)
         dem = self._one_dem
         dem[0, :] = 0.0
         for k, v in request.demand.items():
-            dem[0, res_index[k]] = v
+            if v > 0:                  # zero demand constrains nothing
+                dem[0, res_index[k]] = v
         pref = -1
         if request.preferred_node is not None and not request.avoid_local:
             pref = self._node_index.get(request.preferred_node, -1)
@@ -198,15 +201,14 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
             # the key is cached ON the request: request objects are
             # reused across retry ticks (node_manager caches them on
             # the spec), so the sort runs once per task, not per tick
-            key = getattr(req, "_row_key", None)
-            if key is None:
-                key = tuple(sorted(req.demand.items()))
-                req._row_key = key     # type: ignore[attr-defined]
+            key = request_class_key(req)
             row = row_cache.get(key)
             if row is None:
                 row = np.zeros(n_res, np.float32)
                 ok = True
                 for k, v in req.demand.items():
+                    if v <= 0:
+                        continue       # zero demand constrains nothing
                     j = res_index.get(k)
                     if j is None:
                         ok = False
@@ -254,7 +256,31 @@ class NativeHybridSchedulingPolicy(ISchedulingPolicy):
             else:
                 results[t] = SchedulingResult(
                     self._node_order[out_nodes[row]])
+        if len(requests) > 1:
+            self._fence_batch(requests, results)
         return results
+
+    def _fence_batch(self, requests: Sequence[SchedulingRequest],
+                     results: List[SchedulingResult]) -> None:
+        """Capacity fence (docs/scheduler.md): the fencing contract
+        lives in ``policy.apply_capacity_fence``; this supplies only
+        the dense-matrix bound computation."""
+        alive = self._alive.astype(bool)
+
+        def bound_fn(demand: Dict[str, float], stop_at: int) -> int:
+            row = self._row_cache.get(tuple(sorted(demand.items())))
+            if row is None or row is False:
+                return stop_at         # unknown resource: infeasible path
+            mask = row > 0
+            if not mask.any():
+                return stop_at         # zero-demand: unbounded
+            dem = row[mask]
+            tot = self._total[:, mask]
+            feas = alive & (tot + 1e-9 >= dem).all(axis=1)
+            caps = np.floor((tot + 1e-9) / dem).min(axis=1)
+            return int(caps[feas].sum())
+
+        apply_capacity_fence(requests, results, bound_fn=bound_fn)
 
 
 register_policy("hybrid_native", NativeHybridSchedulingPolicy)

@@ -9,6 +9,19 @@ feasibility masking and utilization scoring are vectorized over ALL
 nodes (VPU), and the whole solve is a single launch with ONE
 device-to-host transfer for the assignment.
 
+Two shapes (docs/scheduler.md):
+
+- ``_pack_kernel``: one group, sequential scan over its bundles, each
+  step scoring ALL N nodes — the original single-group path.
+- ``_pack_batch_kernel``: MANY groups in one launch (a PR-4 restart
+  storm, a PR-6 slice-set re-form). A top-k candidate pre-filter ranks
+  every node once by the strategy's score and deals the ranked nodes
+  round-robin across groups — disjoint candidate sets, so the groups'
+  solves are independent and ``vmap`` runs them in parallel; each
+  group's inner scan then scores only its k candidates instead of all
+  N. One launch, one d2h for the whole storm. A group whose top-k
+  solve fails falls back to the full single-group path host-side.
+
 Strategies: PACK (most-utilized feasible node first — co-locates),
 SPREAD (least-utilized, preferring nodes unused by this group),
 STRICT_SPREAD (distinct node per bundle, hard), STRICT_PACK (the
@@ -71,12 +84,111 @@ def _pack_kernel(avail, total, alive, demands, mode: str):
     return jnp.concatenate([assign, ok_all[None]])
 
 
+@functools.partial(jax.jit, static_argnames=("mode", "k"))
+def _pack_batch_kernel(avail, total, alive, demands, valid, mode: str,
+                       k: int):
+    """Pack G groups in ONE launch. avail/total [N,R] f32, alive [N]
+    bool, demands [G,B,R] f32 (zero rows = padding), valid [G,B] bool
+    -> int32 [G, B+1]: per-bundle GLOBAL node index (-1 = unplaced /
+    padding) + per-group ok flag. One output array = one d2h.
+
+    Top-k pre-filter: nodes are ranked once by the strategy's score
+    (dead/padded rows rank last) and dealt round-robin — group g gets
+    ranks g, g+G, g+2G, … — so candidate sets are DISJOINT and the
+    per-group solves vmap with no cross-group double-allocation. When
+    k*G exceeds N the deal wraps (modulo) and two groups may share a
+    node; the host commit's rollback catches the rare conflict and the
+    group re-solves on the full single-group path."""
+    n = avail.shape[0]
+    g = demands.shape[0]
+
+    util = jnp.max(
+        jnp.where(total > 0.0,
+                  (total - avail) / jnp.maximum(total, _EPS), 0.0),
+        axis=1)                                             # [N]
+    base = -util if mode == "pack" else util
+    ranked = jnp.argsort(jnp.where(alive, base, jnp.inf))   # [N]
+    deal = (jnp.arange(k)[None, :] * g
+            + jnp.arange(g)[:, None]) % n                   # [G, k]
+    cand = ranked[deal]                                     # [G, k]
+
+    cav = avail[cand]        # [G, k, R]
+    ctot = total[cand]
+    cal = alive[cand]        # [G, k]
+
+    def solve_one(cav, ctot, cal, dems, vmask, cidx):
+        def step(carry, inp):
+            av, used = carry
+            demand, v = inp
+            has = demand > 0.0
+            can = cal & jnp.all(
+                jnp.where(has[None, :], av + _EPS >= demand[None, :],
+                          True), axis=1)
+            u = jnp.max(
+                jnp.where(ctot > 0.0,
+                          (ctot - av) / jnp.maximum(ctot, _EPS), 0.0),
+                axis=1)
+            if mode == "pack":
+                score = -u
+            elif mode == "spread":
+                score = u + jnp.where(used, _SPREAD_PENALTY, 0.0)
+            else:  # strict_spread
+                score = u
+                can = can & ~used
+            score = jnp.where(can, score, jnp.inf)
+            idx = jnp.argmin(score)
+            ok = can[idx] & v
+            av = av - jnp.zeros_like(av).at[idx].set(
+                jnp.where(ok, demand, 0.0))
+            # Mark used by GLOBAL node id, not candidate slot: when
+            # k*G exceeds the node count the modulo deal aliases one
+            # node into several slots of a group, and a per-slot mark
+            # would let STRICT_SPREAD place two bundles on the same
+            # physical node through a duplicate slot. (Capacity is
+            # still per-slot — duplicate slots over-admit vs the real
+            # node and the host commit's rollback catches that.)
+            used = jnp.where(ok, used | (cidx == cidx[idx]), used)
+            return (av, used), jnp.where(ok, cidx[idx],
+                                         -1).astype(jnp.int32)
+
+        (_, _), assign = jax.lax.scan(
+            step, (cav, jnp.zeros((k,), bool)), (dems, vmask))
+        ok_all = jnp.all((assign >= 0) | ~vmask)
+        return assign, ok_all
+
+    assign, ok = jax.vmap(solve_one)(cav, ctot, cal, demands, valid,
+                                     cand)
+    return jnp.concatenate(
+        [assign, ok.astype(jnp.int32)[:, None]], axis=1)    # [G, B+1]
+
+
 class PgKernelSolver:
-    """Host wrapper: dense view + strategy dispatch."""
+    """Host wrapper: dense view + strategy dispatch.
+
+    The dense [nodes, resources] view is cached keyed by the cluster
+    resource version (the same seam ``tpu_policy`` uses, now with
+    row-wise incremental refresh): back-to-back solves in one
+    scheduling tick — a restart storm's per-group fallbacks, the
+    batched solve followed by single re-solves — share one rebuild
+    instead of paying a full O(nodes) refresh per call."""
 
     def __init__(self):
         from ray_tpu._private.scheduler.tpu_policy import _DenseView
         self._view = _DenseView()
+
+    def _group_demands(self, view, bundles: List[Dict[str, float]],
+                       strategy: str):
+        """(demand matrix rows, mode) for one group under a strategy:
+        STRICT_PACK collapses to the bundle-sum on one node."""
+        if strategy == "STRICT_PACK":
+            total_demand: Dict[str, float] = {}
+            for b in bundles:
+                for k, v in b.items():
+                    total_demand[k] = total_demand.get(k, 0.0) + v
+            return [view.demand_vector(total_demand)], "spread"
+        mode = {"PACK": "pack", "SPREAD": "spread",
+                "STRICT_SPREAD": "strict_spread"}[strategy]
+        return [view.demand_vector(b) for b in bundles], mode
 
     def solve(self, cluster, bundles: List[Dict[str, float]],
               strategy: str) -> Optional[List]:
@@ -88,19 +200,9 @@ class PgKernelSolver:
         if not view.node_ids:
             return None
 
-        if strategy == "STRICT_PACK":
-            total_demand: Dict[str, float] = {}
-            for b in bundles:
-                for k, v in b.items():
-                    total_demand[k] = total_demand.get(k, 0.0) + v
-            demands = np.stack([view.demand_vector(total_demand)])
-            mode = "spread"     # least-utilized single node with room
-        else:
-            demands = np.stack([view.demand_vector(b) for b in bundles]) \
-                if bundles else np.zeros((0, view.total.shape[1]),
-                                         np.float32)
-            mode = {"PACK": "pack", "SPREAD": "spread",
-                    "STRICT_SPREAD": "strict_spread"}[strategy]
+        rows, mode = self._group_demands(view, bundles, strategy)
+        demands = (np.stack(rows) if rows
+                   else np.zeros((0, view.total.shape[1]), np.float32))
 
         packed = np.asarray(_pack_kernel(
             jnp.asarray(view.avail, jnp.float32),
@@ -115,3 +217,60 @@ class PgKernelSolver:
             nid = view.node_ids[int(assign[0])]
             return [nid] * len(bundles)
         return [view.node_ids[int(i)] for i in assign]
+
+    def solve_many(self, cluster,
+                   group_bundles: List[List[Dict[str, float]]],
+                   strategy: str) -> List[Optional[List]]:
+        """Pack MANY groups of one strategy in a single launch (the
+        restart-storm shape). Returns one assignment list per group;
+        ``None`` entries did not fit their top-k candidate set and
+        should re-solve on the single-group path."""
+        from ray_tpu._private.config import get_config
+        view = self._view
+        view.refresh(cluster, extra_resources=[
+            r for bundles in group_bundles for b in bundles for r in b])
+        n_groups = len(group_bundles)
+        if not view.node_ids or n_groups == 0:
+            return [None] * n_groups
+
+        from ray_tpu._private.scheduler.tpu_policy import _bucket
+        rows_per_group = []
+        mode = "spread"
+        for bundles in group_bundles:
+            rows, mode = self._group_demands(view, bundles, strategy)
+            rows_per_group.append(rows)
+
+        n_pad, n_res = view.total.shape
+        b_pad = _bucket(max(len(r) for r in rows_per_group), minimum=1)
+        g_pad = _bucket(n_groups, minimum=1)
+        demands = np.zeros((g_pad, b_pad, n_res), np.float32)
+        valid = np.zeros((g_pad, b_pad), bool)
+        for g, rows in enumerate(rows_per_group):
+            if rows:
+                demands[g, :len(rows)] = np.stack(rows)
+                valid[g, :len(rows)] = True
+        # Candidate-set size: config floor, but never below the bundle
+        # count (STRICT_SPREAD needs >= B distinct candidates) and
+        # never above the padded node count.
+        k = min(_bucket(max(get_config().pg_pack_topk, b_pad),
+                        minimum=1), n_pad)
+
+        packed = np.asarray(_pack_batch_kernel(
+            jnp.asarray(view.avail, jnp.float32),
+            jnp.asarray(view.total, jnp.float32),
+            jnp.asarray(view.alive),
+            jnp.asarray(demands),
+            jnp.asarray(valid),
+            mode, k))                        # the ONE d2h transfer
+        out: List[Optional[List]] = []
+        for g, bundles in enumerate(group_bundles):
+            if not packed[g, -1]:
+                out.append(None)
+                continue
+            assign = packed[g, :len(rows_per_group[g])]
+            if strategy == "STRICT_PACK":
+                nid = view.node_ids[int(assign[0])]
+                out.append([nid] * len(bundles))
+            else:
+                out.append([view.node_ids[int(i)] for i in assign])
+        return out

@@ -42,6 +42,16 @@ class SchedulingRequest:
 class SchedulingResult:
     node_id: Optional[NodeID]   # None => infeasible or unavailable now
     is_infeasible: bool = False # no node could EVER satisfy the demand
+    # Capacity fence (docs/scheduler.md): the task's scheduling class
+    # exceeds the node-totals capacity bound — the cluster could not
+    # hold this many instances concurrently even when idle. Unlike
+    # is_infeasible, ONE instance is runnable; the owner parks the
+    # surplus in its unplaceable ledger (released on the next cluster
+    # ledger version delta) instead of rescanning it every tick.
+    is_fenced: bool = False
+    # The bound itself, when the policy already computed it — spares
+    # the owner an O(nodes) recompute for the typed signal.
+    fence_bound: Optional[int] = None
 
 
 class ISchedulingPolicy:
@@ -57,6 +67,89 @@ class ISchedulingPolicy:
     def schedule(self, cluster: ClusterResourceManager,
                  request: SchedulingRequest) -> SchedulingResult:
         return self.schedule_batch(cluster, [request])[0]
+
+
+def request_class_key(req: "SchedulingRequest") -> tuple:
+    """Scheduling-class key of a request's demand, cached on the
+    request object: requests are reused across retry ticks (the node
+    manager caches them on the spec), so the sort runs once per task.
+    Shared with the native policy's demand-row cache."""
+    key = getattr(req, "_row_key", None)
+    if key is None:
+        key = tuple(sorted(req.demand.items()))
+        req._row_key = key     # type: ignore[attr-defined]
+    return key
+
+
+def class_capacity_bound(node_totals, demand: Dict[str, float],
+                         stop_at: Optional[int] = None) -> int:
+    """Capacity bound from node TOTALS: how many instances of
+    ``demand`` the cluster could hold concurrently even when idle —
+    sum over feasible nodes of floor(min_r total[r]/demand[r]).
+    Zero-valued demand entries constrain nothing (callers must not
+    fence all-zero demands — they are unbounded). ``node_totals``
+    iterates (total_dict, alive); ``stop_at`` early-outs once the
+    bound provably covers the caller's class. Single source of the
+    fence's epsilon/zero semantics — shared by the Python hybrid
+    policy and the owner ledger's typed-signal bound."""
+    bound = 0
+    for total, alive in node_totals:
+        if not alive:
+            continue
+        cap = None
+        for k, v in demand.items():
+            if v <= 0:
+                continue                # zero demand: no constraint
+            tot = total.get(k, 0.0)
+            if tot + 1e-9 < v:
+                cap = 0
+                break
+            c = int((tot + 1e-9) // v)
+            cap = c if cap is None else min(cap, c)
+        if cap:
+            bound += cap
+            if stop_at is not None and bound >= stop_at:
+                break
+    return bound
+
+
+def apply_capacity_fence(requests: Sequence["SchedulingRequest"],
+                         results: List["SchedulingResult"],
+                         node_totals: Optional[Sequence[tuple]] = None,
+                         bound_fn: Optional[Callable] = None) -> None:
+    """Mark the capacity-infeasible tail of each scheduling class.
+
+    For each class with unplaced members, the capacity bound from node
+    TOTALS — sum over feasible nodes of how many instances their total
+    resources could hold — caps what the cluster fits concurrently
+    even when idle; batch members beyond it get ``is_fenced`` (with
+    the bound attached) so the owner parks them instead of retrying
+    every tick. The bound comes from ``node_totals`` ([(total_dict,
+    alive)] per node) via :func:`class_capacity_bound`, or from
+    ``bound_fn(demand_dict, stop_at) -> int`` — the native policy's
+    dense-matrix variant — so the fencing CONTRACT (class grouping,
+    zero-demand guard, unplaced-tail selection) has one copy.
+    In-place; placed and infeasible results are never touched (the
+    fence refines the plain unavailable-now middle ground only)."""
+    classes: Dict[tuple, List[int]] = {}
+    for i, req in enumerate(requests):
+        classes.setdefault(request_class_key(req), []).append(i)
+    for key, idxs in classes.items():
+        unplaced = [i for i in idxs if results[i].node_id is None
+                    and not results[i].is_infeasible]
+        if not unplaced or not any(v > 0 for _, v in key):
+            continue                    # zero-demand never fences
+        if bound_fn is not None:
+            bound = bound_fn(dict(key), len(idxs))
+        else:
+            bound = class_capacity_bound(node_totals, dict(key),
+                                         stop_at=len(idxs))
+        surplus = len(idxs) - bound
+        if surplus <= 0:
+            continue
+        for i in unplaced[-min(surplus, len(unplaced)):]:
+            results[i] = SchedulingResult(None, is_fenced=True,
+                                          fence_bound=bound)
 
 
 class HybridSchedulingPolicy(ISchedulingPolicy):
@@ -84,6 +177,10 @@ class HybridSchedulingPolicy(ISchedulingPolicy):
         view = cluster.snapshot()
         for req in requests:
             results.append(self._schedule_one(view, req))
+        if len(requests) > 1:
+            apply_capacity_fence(
+                requests, results,
+                [(n.total, n.alive) for n in view.values()])
         return results
 
     def _schedule_one(self, view: Dict[NodeID, NodeResources],

@@ -41,7 +41,7 @@ from __future__ import annotations
 import functools
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -86,32 +86,109 @@ def _schedule_classes_kernel(
 ):
     """Schedule K classes of tasks against N nodes in one device program.
 
-    Returns (per-class):
+    Three admission stages (docs/scheduler.md):
+
+    1. **Feasibility fence.** Per class, the capacity bound from node
+       TOTALS — ``sum_n floor(min_r total[n,r]/demand[r])`` over
+       feasible nodes — caps how many instances the cluster could hold
+       even when idle. Surplus beyond it is *fenced* out before
+       scoring: the fill never attempts it, and the count is reported
+       so the host can park the class (typed) instead of rescanning it
+       every tick.
+    2. **Scarcity-ordered commit.** Classes commit in descending order
+       of their scarcest demanded resource's pressure
+       (class-demand-weighted total demand / live supply), so
+       abundant-resource classes cannot strand scarce (TPU) capacity
+       ahead of the classes that need it. Outputs are returned in the
+       caller's class order.
+    3. **Residual fill.** A second fill pass (``lax.cond``-gated, so
+       it costs nothing when the first pass placed everything it
+       admitted) re-runs the water-fill over each class's unplaced
+       admitted remainder against the post-commit availability — the
+       backstop that keeps "every capacity-feasible task lands" an
+       invariant rather than a proof obligation on the fp-exactness of
+       the bisection fill.
+
+    Returns (per-class, caller's order):
       local_take  [K]      — tasks packed onto the preferred node
+      any_feasible[K]      — some alive node could EVER run the class
+      fenced      [K]      — surplus beyond the totals capacity bound
+      admitted    [K]      — min(count - fenced, live capacity at the
+                             class's commit turn): what could place NOW
       order       [K, N]   — node indices in fill order (post-local)
       take_sorted [K, N]   — tasks given to order[k, j]
-      any_feasible[K]      — some alive node could EVER run the class
+      order2/take2[K, N]   — residual-pass placements (zeros when the
+                             residual pass did not run)
       new_avail   [N, R]
     """
     n_nodes = avail.shape[0]
+    countsf = counts.astype(jnp.float32)
+
+    # ---- scarcity ordering: commit scarce-resource classes first ----
+    # Primary key: RARITY of the class's scarcest demanded resource —
+    # the fraction of alive nodes whose totals carry it at all. A class
+    # demanding a resource that lives on few nodes (TPU, custom) must
+    # commit before abundant-resource classes eat those nodes'
+    # complementary capacity (CPU/memory) and strand it; rarity is
+    # count-independent, so an over-subscribed abundant resource can't
+    # jump the queue. Secondary key: demand pressure (class-weighted
+    # total demand / live supply), descending — among equally-rare
+    # classes the most contended commits first.
+    has_d = demands > 0.0                                            # [K, R]
+    n_alive = jnp.maximum(jnp.sum(alive.astype(jnp.float32)), 1.0)
+    res_frac = (jnp.sum((total > 0.0) & alive[:, None], axis=0)
+                .astype(jnp.float32) / n_alive)                      # [R]
+    rarity = jnp.min(jnp.where(has_d, res_frac[None, :], jnp.inf),
+                     axis=1)                                         # [K]
+    supply = jnp.sum(jnp.where(alive[:, None], avail, 0.0), axis=0)  # [R]
+    class_demand = countsf[:, None] * demands                        # [K, R]
+    pressure = jnp.sum(class_demand, axis=0) / jnp.maximum(supply, _EPS)
+    press_k = jnp.max(jnp.where(has_d, pressure[None, :], -jnp.inf),
+                      axis=1)                                        # [K]
+    rarity = jnp.where(counts > 0, rarity, jnp.inf)       # pads last
+    press_k = jnp.where(counts > 0, press_k, -jnp.inf)
+    perm = jnp.lexsort((-press_k, rarity))    # rarity asc, pressure desc
+    inv = jnp.argsort(perm)
+    demands_c = demands[perm]
+    counts_c = countsf[perm]
+    prefs_c = prefs[perm]
 
     def step(carry, cls):
         avail = carry
-        demand, count, pref = cls          # [R], scalar, scalar
+        demand, countf, pref = cls         # [R], scalar f32, scalar
         has_demand = demand > 0.0          # [R]
 
-        # Feasibility vs totals (could this node EVER run it).
-        feas = jnp.all(jnp.where(has_demand[None, :],
-                                 total + _EPS >= demand[None, :], True),
-                       axis=1) & alive                      # [N]
+        # Capacity bound from node totals: surplus beyond it can never
+        # run concurrently on this node set — fence it out before
+        # scoring (it never enters the fill below). cap_tot also
+        # SUBSUMES the per-node feasibility test: a node whose totals
+        # fit one instance has cap_tot >= 1 (an infeasible node's min
+        # ratio is < 1, so its floor is already 0), so the fence costs
+        # no extra [N, R] pass over the pre-fence kernel.
+        ratio_tot = jnp.where(has_demand[None, :],
+                              (total + _EPS) /
+                              jnp.maximum(demand[None, :], _EPS),
+                              jnp.inf)                       # [N, R]
+        cap_tot = jnp.floor(jnp.min(ratio_tot, axis=1))      # [N]
+        cap_tot = jnp.where(alive, cap_tot, 0.0)
+        feas = cap_tot >= 1.0                                # [N]
         any_feasible = jnp.any(feas)
+        # int32-safe clamp: a zero-demand class's bound is +inf
+        upper_total = jnp.minimum(jnp.sum(cap_tot),
+                                  jnp.float32(2 ** 30))
+        fenced = jnp.clip(countf - upper_total, 0.0, None)
+        fenced = jnp.where(countf > 0, fenced, 0.0)
+        target = countf - fenced           # what the fill may attempt
 
         # Per-node capacity right now.
         ratio = jnp.where(has_demand[None, :],
                           (avail + _EPS) / jnp.maximum(demand[None, :], _EPS),
                           jnp.inf)                           # [N, R]
         cap = jnp.floor(jnp.min(ratio, axis=1))              # [N]
-        cap = jnp.where(feas, jnp.minimum(cap, count.astype(cap.dtype)), 0.0)
+        cap = jnp.where(feas, jnp.minimum(cap, target), 0.0)
+        # Live admission bound at this class's commit turn: of the
+        # un-fenced target, how much fits the CARRIED availability.
+        admitted = jnp.minimum(target, jnp.sum(cap))
 
         # Critical utilization (hybrid policy's packing signal).
         used = total - avail
@@ -130,12 +207,12 @@ def _schedule_classes_kernel(
         c_thresh = jnp.clip(jnp.min(c_r), 0.0, None)
         local_take = jnp.where(
             pref_valid & (util[p] < threshold),
-            jnp.minimum(jnp.minimum(c_thresh, cap[p]), count.astype(jnp.float32)),
+            jnp.minimum(jnp.minimum(c_thresh, cap[p]), target),
             0.0)
-        local_take = jnp.where(count > 0, local_take, 0.0)
+        local_take = jnp.where(countf > 0, local_take, 0.0)
         avail = avail - jnp.zeros_like(avail).at[p].set(local_take * demand)
         cap = cap.at[p].add(-local_take)
-        remaining = count.astype(jnp.float32) - local_take
+        remaining = target - local_take
 
         # --- Phase 2: utilization water-fill ---
         # Sequential hybrid places each task on the currently
@@ -180,26 +257,84 @@ def _schedule_classes_kernel(
         taken = jnp.zeros((n_nodes,)).at[order].set(take_sorted)
         avail = avail - taken[:, None] * demand[None, :]
 
-        return avail, (local_take.astype(jnp.int32),
-                       order.astype(jnp.int32),
-                       take_sorted.astype(jnp.int32),
-                       any_feasible)
+        return avail, (local_take, order.astype(jnp.int32), take_sorted,
+                       any_feasible, fenced, admitted, upper_total)
 
-    avail, (local_take, order, take_sorted, any_feasible) = jax.lax.scan(
-        step, avail, (demands, counts, prefs), length=num_classes)
+    avail, (local_take, order, take_sorted,
+            any_feasible, fenced, admitted, upper) = jax.lax.scan(
+        step, avail, (demands_c, counts_c, prefs_c), length=num_classes)
+
+    # ---- residual second fill pass (capacity-feasible backstop) ----
+    # The fill's contract is placed == admitted (the live bound at the
+    # class's turn); the residual is any admitted-but-unplaced
+    # shortfall — 0 in exact arithmetic, so the cond's cheap branch is
+    # the steady state and the headline rate pays nothing. Surplus
+    # beyond `admitted` is NOT residual: the carried availability is
+    # provably exhausted for it this round. placed clamps at admitted:
+    # a zero-demand class water-fills count on every node (the host
+    # consumes only count assignments), so the raw take sum can
+    # legitimately exceed the class count.
+    placed1 = jnp.minimum(local_take + jnp.sum(take_sorted, axis=1),
+                          admitted)
+    residual = jnp.clip(admitted - placed1, 0.0, None)
+
+    def run_residual(op):
+        avail, residual = op
+        # No preferred-node phase: the residual is pure water-fill.
+        no_pref = jnp.full_like(prefs_c, -1)
+        avail, (_, order2, take2, _, _, _, _) = jax.lax.scan(
+            step, avail, (demands_c, residual, no_pref),
+            length=num_classes)
+        return avail, order2, take2
+
+    def skip_residual(op):
+        avail, _ = op
+        zeros_i = jnp.zeros((num_classes, n_nodes), jnp.int32)
+        return avail, zeros_i, jnp.zeros((num_classes, n_nodes),
+                                         jnp.float32)
+
+    avail, order2, take2 = jax.lax.cond(
+        jnp.sum(residual) > 0.0, run_residual, skip_residual,
+        (avail, residual))
+
     # Pack every host-bound output into ONE int32 array so the policy
     # pays for a single device->host transfer per invocation (transfer
     # count, not bytes, dominates dispatch latency on remote-attached
-    # TPUs, and it is one DMA either way on local PCIe).
+    # TPUs, and it is one DMA either way on local PCIe). Rows are
+    # gathered back to the CALLER's class order — the scarcity
+    # permutation is internal to the commit sequence.
     packed = jnp.concatenate(
-        [local_take[:, None], any_feasible.astype(jnp.int32)[:, None],
-         order, take_sorted], axis=1)                  # [K, 2N+2]
-    return packed, avail
+        [local_take[:, None], any_feasible.astype(jnp.float32)[:, None],
+         fenced[:, None], admitted[:, None], upper[:, None],
+         order.astype(jnp.float32), take_sorted,
+         order2.astype(jnp.float32), take2], axis=1)   # [K, 4N+5]
+    return packed[inv].astype(jnp.int32), avail
 
 
 # --------------------------------------------------------------------------
 # Host-side policy
 # --------------------------------------------------------------------------
+
+class DenseSchedule(NamedTuple):
+    """One kernel invocation's host-side outputs (caller class order).
+
+    ``fenced[k]`` tasks of class k exceed the node-totals capacity
+    bound (the cluster could not hold them even idle); ``admitted[k]``
+    is the live bound at the class's commit turn — the fill places
+    exactly this many, so ``placed == admitted`` is the kernel's
+    completeness contract (docs/scheduler.md)."""
+
+    local_take: np.ndarray    # [K]
+    any_feasible: np.ndarray  # [K] bool
+    fenced: np.ndarray        # [K]
+    admitted: np.ndarray      # [K]
+    upper_total: np.ndarray   # [K] totals bound (int32-clamped)
+    order: np.ndarray         # [K, N]
+    take_sorted: np.ndarray   # [K, N]
+    order2: np.ndarray        # [K, N]  residual pass
+    take2: np.ndarray         # [K, N]
+    new_avail: jax.Array      # [N, R]
+
 
 class _DenseView:
     """Dense [nodes, resources] mirror of a ClusterResourceManager
@@ -221,6 +356,25 @@ class _DenseView:
         extra = [r for r in extra_resources if r not in self.res_index]
         if version == self.version and not extra:
             return
+        # Incremental path: between full rebuilds, only rows whose
+        # nodes mutated since the cached version are rewritten (the
+        # manager's bounded mutation log names them), so steady-state
+        # per-batch cost is O(dirty nodes), not O(cluster). Membership
+        # changes, log overrun, and new resource names fall back to
+        # the full rebuild below.
+        if self.version >= 0 and not extra:
+            delta = cluster.changes_since(self.version)
+            if delta is not None and not delta[1]:
+                for nid in delta[0]:
+                    i = self.node_index.get(nid)
+                    node = cluster.get_node(nid)
+                    if i is None or node is None or any(
+                            r not in self.res_index for r in node.total):
+                        break          # unknown row/column: rebuild
+                    self._write_row(i, node)
+                else:
+                    self.version = version
+                    return
         snapshot = cluster.snapshot()
         names = set(extra_resources)
         for node in snapshot.values():
@@ -235,13 +389,21 @@ class _DenseView:
         self.total = np.zeros((n_pad, r_pad), np.float32)
         self.alive = np.zeros((n_pad,), bool)
         for i, nid in enumerate(self.node_ids):
-            node = snapshot[nid]
-            self.alive[i] = node.alive
-            for r, v in node.total.items():
-                self.total[i, self.res_index[r]] = v
-            for r, v in node.available.items():
-                self.avail[i, self.res_index[r]] = v
+            self._write_row(i, snapshot[nid])
         self.version = version
+
+    def _write_row(self, i: int, node) -> None:
+        self.alive[i] = node.alive
+        self.total[i, :] = 0.0
+        self.avail[i, :] = 0.0
+        # list(): incremental refresh reads the LIVE node dicts, which
+        # completion threads mutate concurrently
+        for r, v in list(node.total.items()):
+            self.total[i, self.res_index[r]] = v
+        for r, v in list(node.available.items()):
+            j = self.res_index.get(r)
+            if j is not None:
+                self.avail[i, j] = v
 
     def demand_vector(self, demand: Dict[str, float]) -> np.ndarray:
         vec = np.zeros((self.total.shape[1],), np.float32)
@@ -279,10 +441,8 @@ class TpuSchedulingPolicy(ISchedulingPolicy):
         demands: np.ndarray,     # [K, R]
         counts: np.ndarray,      # [K]
         prefs: np.ndarray,       # [K]
-    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, jax.Array]:
-        """Run the kernel on dense matrices. Returns
-        (local_take[K], order[K,N], take_sorted[K,N], any_feasible[K],
-        new_avail[N,R] device array)."""
+    ) -> "DenseSchedule":
+        """Run the kernel on dense matrices; one launch, one d2h."""
         k_pad = _bucket(len(counts), minimum=1)
         if k_pad != len(counts):
             demands = np.pad(demands, ((0, k_pad - len(counts)), (0, 0)))
@@ -301,11 +461,18 @@ class TpuSchedulingPolicy(ISchedulingPolicy):
         )
         packed = np.asarray(packed)          # the ONE d2h transfer
         n = avail.shape[0]
-        local_take = packed[:, 0]
-        any_feasible = packed[:, 1].astype(bool)
-        order = packed[:, 2:2 + n]
-        take_sorted = packed[:, 2 + n:2 + 2 * n]
-        return local_take, order, take_sorted, any_feasible, new_avail
+        return DenseSchedule(
+            local_take=packed[:, 0],
+            any_feasible=packed[:, 1].astype(bool),
+            fenced=packed[:, 2],
+            admitted=packed[:, 3],
+            upper_total=packed[:, 4],
+            order=packed[:, 5:5 + n],
+            take_sorted=packed[:, 5 + n:5 + 2 * n],
+            order2=packed[:, 5 + 2 * n:5 + 3 * n],
+            take2=packed[:, 5 + 3 * n:5 + 4 * n],
+            new_avail=new_avail,
+        )
 
     # -- ISchedulingPolicy ------------------------------------------------
 
@@ -335,30 +502,70 @@ class TpuSchedulingPolicy(ISchedulingPolicy):
         counts = np.array([len(classes[k]) for k in keys], np.int32)
         prefs = np.array([k[1] for k in keys], np.int32)
 
-        local_take, order, take_sorted, any_feasible, _ = \
-            self.schedule_dense(view.avail, view.total, view.alive,
-                                demands, counts, prefs)
+        ds = self.schedule_dense(view.avail, view.total, view.alive,
+                                 demands, counts, prefs)
 
         # Expand per-node counts back to per-task results.
         results: List[Optional[SchedulingResult]] = [None] * len(requests)
         for k, key in enumerate(keys):
             indices = classes[key]
+            count = len(indices)
             fill = []
-            if local_take[k] > 0:
-                fill.append(np.full(local_take[k], key[1], np.int32))
-            nz = take_sorted[k] > 0
-            if nz.any():
-                fill.append(np.repeat(order[k][nz], take_sorted[k][nz]))
+            if ds.local_take[k] > 0:
+                fill.append(np.full(ds.local_take[k], key[1], np.int32))
+            for order_k, take_k in ((ds.order[k], ds.take_sorted[k]),
+                                    (ds.order2[k], ds.take2[k])):
+                nz = take_k > 0
+                if nz.any():
+                    fill.append(np.repeat(order_k[nz], take_k[nz]))
             assigned = (np.concatenate(fill) if fill
                         else np.empty(0, np.int32))
-            feasible = bool(any_feasible[k])
+            feasible = bool(ds.any_feasible[k])
+            fenced_k = int(ds.fenced[k])
+            placed = min(len(assigned), count)
             for j, req_i in enumerate(indices):
-                if j < len(assigned):
+                if j < placed:
                     results[req_i] = SchedulingResult(
                         view.node_ids[int(assigned[j])])
-                else:
+                elif not feasible:
                     results[req_i] = SchedulingResult(
-                        None, is_infeasible=not feasible)
+                        None, is_infeasible=True)
+                elif j >= count - fenced_k:
+                    # Surplus beyond the class's node-totals capacity
+                    # bound: the owner parks it in the unplaceable
+                    # ledger (typed) instead of retrying every tick.
+                    results[req_i] = SchedulingResult(
+                        None, is_fenced=True,
+                        fence_bound=int(ds.upper_total[k]))
+                else:
+                    results[req_i] = SchedulingResult(None)
+
+        # Kernel classes key by (demand, preferred node) but the
+        # totals bound is a per-DEMAND cluster-wide quantity: classes
+        # sharing a demand would each be granted the full bound and
+        # under-fence the joint surplus. Top up across the group.
+        by_demand: Dict[tuple, List[int]] = {}
+        for k, key in enumerate(keys):
+            by_demand.setdefault(key[0], []).append(k)
+        for dkey, ks in by_demand.items():
+            if len(ks) < 2 or not any(v > 0 for _, v in dkey):
+                continue
+            upper = int(ds.upper_total[ks[0]])   # same for the group
+            group_count = sum(len(classes[keys[k]]) for k in ks)
+            need = (max(group_count - upper, 0)
+                    - sum(int(ds.fenced[k]) for k in ks))
+            for k in ks:
+                if need <= 0:
+                    break
+                for req_i in reversed(classes[keys[k]]):
+                    if need <= 0:
+                        break
+                    r = results[req_i]
+                    if (r.node_id is None and not r.is_infeasible
+                            and not r.is_fenced):
+                        results[req_i] = SchedulingResult(
+                            None, is_fenced=True, fence_bound=upper)
+                        need -= 1
         return results
 
 

@@ -109,6 +109,13 @@ def install_runtime_metrics() -> None:
         tasks.set(ng_stats.get("shed", 0), tags={"state": "shed"})
         tasks.set(ng_stats.get("deferred", 0),
                   tags={"state": "backpressured"})
+        # placement plane (docs/scheduler.md): live count of tasks the
+        # cluster cannot currently hold — parked totals-infeasible plus
+        # the capacity-fenced unplaceable ledger; returns to zero when
+        # capacity appears and the classes drain
+        tasks.set(ng_stats.get("infeasible", 0)
+                  + ng_stats.get("unplaceable", 0),
+                  tags={"state": "infeasible"})
         oom_kills.set(tm.get("oom_kills", 0))
         inflight.clear()
         for node_hex, count in w.node_group.inflight_windows().items():
@@ -118,7 +125,7 @@ def install_runtime_metrics() -> None:
         objects.set(store["capacity_bytes"], tags={"kind": "capacity"})
         hbm.set(w.device_store.stats()["hbm_bytes"])
         for queue in ("to_schedule", "waiting_deps", "running",
-                      "infeasible", "deferred"):
+                      "infeasible", "unplaceable", "deferred"):
             sched.set(ng_stats.get(queue, 0), tags={"queue": queue})
         infos = w.gcs.get_all_node_info()
         nodes.set(sum(1 for i in infos if i.alive), tags={"state": "alive"})

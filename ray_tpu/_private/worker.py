@@ -583,6 +583,9 @@ class Worker:
                 "queued_tasks": len(self.node_group._to_schedule),
                 "running_tasks": len(self.node_group._running),
                 "actors": len(self.node_group._actor_workers),
+                # unplaceable-class ledger size (capacity fence,
+                # docs/scheduler.md) — the head's heartbeat-analog stat
+                "unplaceable": self.node_group.unplaceable_size(),
                 "store_used_bytes": store["used_bytes"],
                 "store_num_objects": store["num_objects"],
                 "workers_rss_bytes": sum(head_rss.values()),

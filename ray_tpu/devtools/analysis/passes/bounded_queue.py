@@ -30,7 +30,7 @@ from ray_tpu.devtools.analysis.core import (FileContext, Finding,
                                             suppressed_by_mark)
 
 PASS_ID = "bounded-queue"
-VERSION = 5   # v5: data-plane fast-path flush buffers in scope
+VERSION = 6   # v6: placement-plane modules (fence ledger, pg batch solver)
 
 _SCOPES = ("_private/", "collective/", "multislice/",
            "analysis_fixtures/")

@@ -33,7 +33,7 @@ from typing import List
 from ray_tpu.devtools.analysis.core import FileContext, Finding, attr_tail
 
 PASS_ID = "deadline-discipline"
-VERSION = 3   # v3: data-plane coalescing flush loops in scope
+VERSION = 4   # v4: placement-plane modules (fence ledger, pg batch solver)
 
 _SCOPES = ("_private/", "collective/", "multislice/",
            "analysis_fixtures/")

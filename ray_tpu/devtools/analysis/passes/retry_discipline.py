@@ -29,7 +29,7 @@ from typing import List
 from ray_tpu.devtools.analysis.core import FileContext, Finding
 
 PASS_ID = "retry-discipline"
-VERSION = 4   # v4: data-plane fast-path modules (wire_stats, coalescers)
+VERSION = 5   # v5: placement-plane modules (fence ledger, pg batch solver)
 
 # Enforced scopes: the runtime core, the collective/gang plane, plus
 # the lint fixture tree (the self-test floor in

@@ -176,6 +176,32 @@ class OutOfMemoryError(SystemOverloadError):
     error at ``get()`` for non-retryable ones."""
 
 
+class CapacityInfeasibleError(SystemOverloadError):
+    """A scheduling class's pending count exceeds the cluster's
+    capacity bound from node TOTALS: even an idle cluster could not
+    hold ``pending`` instances of ``demand`` concurrently (the bound
+    sums, over nodes whose totals fit one instance, how many each
+    could hold — docs/scheduler.md). Distinct from plain
+    infeasibility: when ``bound`` is 0 NO node can EVER run one
+    instance; when ``bound`` > 0 the surplus is schedulable later, as
+    running work finishes or nodes join, so the owner parks the class
+    in its unplaceable ledger — released on the next cluster-ledger
+    version delta — instead of rescanning it every tick. Retryable by
+    construction: nothing ran."""
+
+    def __init__(self, msg: str = "demand exceeds cluster capacity",
+                 demand: Optional[dict] = None, bound: int = 0,
+                 pending: int = 0):
+        super().__init__(msg, retryable=True, backoff_s=0.0)
+        self.demand = dict(demand or {})
+        self.bound = int(bound)
+        self.pending = int(pending)
+
+    def __reduce__(self):
+        return (type(self), (self.args[0] if self.args else "",
+                             self.demand, self.bound, self.pending))
+
+
 class CollectiveAbortError(RayTpuError):
     """A collective group was aborted mid-operation: a member died (or
     the gang's epoch was fenced off) while this rank was inside a

@@ -81,6 +81,129 @@ def test_kernel_assignments_respect_capacity(strategy):
         assert len(set(assign)) == len(bundles)
 
 
+@pytest.mark.parametrize("strategy",
+                         ["PACK", "SPREAD", "STRICT_SPREAD",
+                          "STRICT_PACK"])
+def test_solve_many_batched_semantics(strategy):
+    """The vmapped multi-group solve respects per-strategy semantics
+    and — because candidate sets are dealt disjoint — never
+    double-allocates a node across groups."""
+    cluster, _ = _cluster([{"CPU": 16, "memory": 64}] * 32)
+    solver = PgKernelSolver()
+    groups = [[{"CPU": 2.0, "memory": 4.0}] * 4 for _ in range(6)]
+    out = solver.solve_many(cluster, groups, strategy)
+    assert all(a is not None for a in out)
+    usage = {}
+    for assign, bundles in zip(out, groups):
+        if strategy in ("PACK", "STRICT_PACK"):
+            assert len(set(assign)) == 1
+        if strategy == "STRICT_SPREAD":
+            assert len(set(assign)) == len(bundles)
+        for nid, b in zip(assign, bundles):
+            u = usage.setdefault(nid, {})
+            for k, v in b.items():
+                u[k] = u.get(k, 0.0) + v
+    view = {nid: res for nid, res in cluster.nodes()}
+    for nid, u in usage.items():
+        for k, v in u.items():
+            assert v <= view[nid].total[k] + 1e-6
+
+
+def test_solve_many_strict_spread_distinct_through_aliased_slots():
+    """Regression: on clusters smaller than the top-k deal (k*G > N)
+    the modulo deal aliases one node into several candidate slots of a
+    group; STRICT_SPREAD must still never place two bundles of one
+    group on the same physical node (a per-slot 'used' mark let the
+    duplicate slot through). Skew utilization so the aliased node
+    always wins argmin."""
+    cluster, ids = _cluster([{"CPU": 16}] * 4)
+    cluster.allocate(ids[1], {"CPU": 8})   # others strictly preferred
+    cluster.allocate(ids[2], {"CPU": 10})
+    cluster.allocate(ids[3], {"CPU": 12})
+    solver = PgKernelSolver()
+    for n_groups in (2, 3):
+        out = solver.solve_many(
+            cluster, [[{"CPU": 1.0}] * 3] * n_groups, "STRICT_SPREAD")
+        for assign in out:
+            if assign is not None:
+                assert len(set(assign)) == 3, assign
+
+
+def test_solve_many_strict_pack_no_single_node_fits():
+    """STRICT_PACK whose bundle-sum exceeds every node's totals fails
+    per group (None) on the batched path, like the single path."""
+    cluster, _ = _cluster([{"CPU": 16}] * 8)
+    solver = PgKernelSolver()
+    groups = [[{"CPU": 10.0}] * 3] * 4          # sum 30 > any node
+    assert solver.solve_many(cluster, groups, "STRICT_PACK") == \
+        [None] * 4
+    assert solver.solve(cluster, groups[0], "STRICT_PACK") is None
+
+
+def test_solver_dense_view_staleness_regression():
+    """The solver's dense view is cached keyed by the cluster resource
+    version: same version -> no rebuild (no snapshot), version delta
+    -> row-wise refresh that MUST observe out-of-band allocations."""
+    cluster, ids = _cluster([{"CPU": 8}, {"CPU": 8}])
+    solver = PgKernelSolver()
+    assert solver.solve(cluster, [{"CPU": 6}] * 2, "SPREAD") is not None
+
+    snaps = {"n": 0}
+    orig_snapshot = cluster.snapshot
+
+    def counting_snapshot():
+        snaps["n"] += 1
+        return orig_snapshot()
+
+    cluster.snapshot = counting_snapshot
+    # same version: cached view, no snapshot at all
+    assert solver.solve(cluster, [{"CPU": 6}] * 2, "SPREAD") is not None
+    assert snaps["n"] == 0
+    # out-of-band allocation (version delta): the view must refresh —
+    # two 6-CPU bundles no longer fit 2-free + 8-free — and the
+    # incremental row-wise path must not pay a full snapshot either
+    assert cluster.allocate(ids[0], {"CPU": 6})
+    assert solver.solve(cluster, [{"CPU": 6}] * 2, "SPREAD") is None
+    assert snaps["n"] == 0
+    # freeing restores capacity through the same incremental path
+    cluster.free(ids[0], {"CPU": 6})
+    assert solver.solve(cluster, [{"CPU": 6}] * 2, "SPREAD") is not None
+    assert snaps["n"] == 0
+
+
+def test_manager_batches_pending_storm():
+    """A restart-storm-shaped burst of pending groups packs through
+    ONE batched launch (num_batched_solves) and every group commits."""
+    from ray_tpu._private.ids import PlacementGroupID
+    from ray_tpu._private.placement_group_manager import (
+        PlacementGroupManager)
+
+    cfg = get_config()
+    cfg.apply_system_config({"pg_kernel_min_work": 1,
+                             "use_tpu_scheduler": "1"})
+    try:
+        cluster = ClusterResourceManager()
+        mgr = PlacementGroupManager(cluster)
+        # no capacity yet: the storm's groups all park PENDING
+        infos = [mgr.create(PlacementGroupID.from_random(),
+                            [{"CPU": 2.0}] * 2, "SPREAD")
+                 for _ in range(6)]
+        assert all(i.state == "PENDING" for i in infos)
+        for spec in [{"CPU": 8.0}] * 4:
+            cluster.add_or_update_node(
+                NodeID.from_random(),
+                NodeResources(total=dict(spec), available=dict(spec)))
+        mgr.try_schedule_pending()
+        assert mgr.num_batched_solves >= 1
+        assert all(i.state == "CREATED" for i in infos)
+        # commits drew real capacity: 6 groups x 2 bundles x 2 CPU
+        free = sum(n.available["CPU"] for _, n in cluster.nodes())
+        assert free == 4 * 8.0 - 24.0
+    finally:
+        cfg.apply_system_config({"pg_kernel_min_work": 4096,
+                                 "use_tpu_scheduler": "auto"})
+
+
 def test_manager_uses_kernel_above_threshold(ray_start_cluster):
     """PlacementGroupManager routes big solves through the kernel when
     the TPU scheduler is enabled."""

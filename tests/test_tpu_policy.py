@@ -178,3 +178,211 @@ def test_registry_selection():
     from ray_tpu._private.scheduler.policy import create_policy
     pol = create_policy("tpu")
     assert isinstance(pol, TpuSchedulingPolicy)
+
+
+# --- feasibility-fenced admission / scarcity ordering (docs/scheduler.md)
+
+
+def test_capacity_fence_marks_totals_surplus():
+    """Surplus beyond the node-totals capacity bound is is_fenced (not
+    is_infeasible): 2x2-CPU nodes, 10 one-CPU tasks -> 4 placed, 6
+    fenced."""
+    cluster, _ = make_cluster([2, 2])
+    pol = TpuSchedulingPolicy()
+    results = pol.schedule_batch(
+        cluster, [SchedulingRequest(demand={"CPU": 1}) for _ in range(10)])
+    assert sum(1 for r in results if r.node_id is not None) == 4
+    fenced = [r for r in results if r.is_fenced]
+    assert len(fenced) == 6
+    assert all(not r.is_infeasible for r in fenced)
+    # placed results come first, the fenced tail last (FIFO fairness)
+    assert all(r.node_id is not None for r in results[:4])
+
+
+def test_cpu_hybrid_fence_parity():
+    """The pure-Python hybrid applies the same totals-bound fence, so
+    the owner ledger works on every policy path."""
+    from ray_tpu._private.scheduler.policy import HybridSchedulingPolicy
+    cluster, _ = make_cluster([2, 2])
+    results = HybridSchedulingPolicy(seed=0).schedule_batch(
+        cluster, [SchedulingRequest(demand={"CPU": 1}) for _ in range(10)])
+    assert sum(1 for r in results if r.node_id is not None) == 4
+    assert sum(1 for r in results if r.is_fenced) == 6
+
+
+def test_native_hybrid_fence_parity_and_zero_demand():
+    """The native C++ wrapper fences like the other policies (shared
+    apply_capacity_fence contract), carries the bound, and treats
+    zero-valued demand entries — even for resources no node has — as
+    constraining nothing (they were permanently infeasible before)."""
+    try:
+        from ray_tpu._private.scheduler import native_policy
+    except ImportError:
+        pytest.skip("native scheduler library unavailable")
+    pol = native_policy.NativeHybridSchedulingPolicy()
+    cluster, _ = make_cluster([2, 2])
+    results = pol.schedule_batch(
+        cluster, [SchedulingRequest(demand={"CPU": 1})
+                  for _ in range(10)])
+    assert sum(1 for r in results if r.node_id is not None) == 4
+    fenced = [r for r in results if r.is_fenced]
+    assert len(fenced) == 6
+    assert all(r.fence_bound == 4 for r in fenced)
+
+    pol2 = native_policy.NativeHybridSchedulingPolicy()
+    cluster2, _ = make_cluster([1])
+    results = pol2.schedule_batch(
+        cluster2, [SchedulingRequest(demand={"CPU": 1, "custom": 0.0})
+                   for _ in range(3)])
+    assert sum(1 for r in results if r.node_id is not None) == 1
+    assert sum(1 for r in results if r.is_fenced) == 2
+    assert all(not r.is_infeasible for r in results)
+    # single-task path: same zero-demand semantics
+    one = pol2.schedule(cluster2, SchedulingRequest(
+        demand={"custom": 0.0}))
+    assert not one.is_infeasible
+
+
+def test_scarcity_order_rescues_scarce_capacity():
+    """Queue order would let the abundant CPU class eat the TPU node's
+    CPU and strand the TPU class; rarity-ordered commit places all 4."""
+    cluster = ClusterResourceManager()
+    ids = [NodeID.from_random(), NodeID.from_random()]
+    cluster.add_or_update_node(ids[0], NodeResources.of(CPU=2, TPU=2))
+    cluster.add_or_update_node(ids[1], NodeResources.of(CPU=2))
+    reqs = ([SchedulingRequest(demand={"CPU": 1}) for _ in range(2)]
+            + [SchedulingRequest(demand={"CPU": 1, "TPU": 1})
+               for _ in range(2)])
+    results = TpuSchedulingPolicy().schedule_batch(cluster, reqs)
+    assert sum(1 for r in results if r.node_id is not None) == 4
+    # the TPU class landed on the only TPU node
+    assert all(r.node_id == ids[0] for r in results[2:])
+    # ...even when the abundant class is over-subscribed (rarity is
+    # count-independent, so CPU pressure can't jump the queue)
+    cluster2 = ClusterResourceManager()
+    cluster2.add_or_update_node(ids[0], NodeResources.of(CPU=2, TPU=2))
+    cluster2.add_or_update_node(ids[1], NodeResources.of(CPU=2))
+    reqs2 = ([SchedulingRequest(demand={"CPU": 1}) for _ in range(5)]
+             + [SchedulingRequest(demand={"CPU": 1, "TPU": 1})
+                for _ in range(2)])
+    results2 = TpuSchedulingPolicy().schedule_batch(cluster2, reqs2)
+    assert sum(1 for r in results2[5:] if r.node_id is not None) == 2
+
+
+def test_preferred_node_dead_falls_through():
+    """A class preferring a dead node takes zero local placements and
+    water-fills the survivors instead."""
+    cluster, ids = make_cluster([8, 8])
+    node = cluster.get_node(ids[0])
+    node.alive = False
+    cluster.add_or_update_node(ids[0], node)
+    pol = TpuSchedulingPolicy()
+    results = pol.schedule_batch(cluster, [
+        SchedulingRequest(demand={"CPU": 1}, preferred_node=ids[0])
+        for _ in range(4)])
+    assert all(r.node_id == ids[1] for r in results)
+
+
+def test_zero_count_padded_classes_are_inert():
+    """schedule_dense pads K to a power of two; padded (count 0)
+    classes must produce no placements, no fences, no admissions."""
+    pol = TpuSchedulingPolicy()
+    total = np.full((2, 2), 4.0, np.float32)
+    avail = total.copy()
+    alive = np.ones(2, bool)
+    demands = np.array([[1.0, 0.0], [0.0, 1.0], [2.0, 0.0]], np.float32)
+    counts = np.array([3, 0, 1], np.int32)      # K=3 pads to 4
+    prefs = np.full(3, -1, np.int32)
+    ds = pol.schedule_dense(avail, total, alive, demands, counts, prefs)
+    placed = ds.local_take + ds.take_sorted.sum(axis=1) + \
+        ds.take2.sum(axis=1)
+    assert placed[0] == 3 and placed[2] == 1
+    assert placed[1] == 0 and ds.fenced[1] == 0 and ds.admitted[1] == 0
+    assert placed[3] == 0 and ds.fenced[3] == 0     # the pad row
+
+
+def test_donated_avail_buffer_reuse_across_invocations():
+    """The kernel donates its availability input; back-to-back
+    invocations against the same host view must neither fail nor
+    corrupt the view (the donation consumes only the device copy)."""
+    cluster, _ = make_cluster([4, 4])
+    pol = TpuSchedulingPolicy()
+    reqs = [SchedulingRequest(demand={"CPU": 1}) for _ in range(3)]
+    pol.schedule_batch(cluster, reqs)
+    view = pol._view
+    before = view.avail.copy()
+    ds1 = pol.schedule_dense(view.avail, view.total, view.alive,
+                             np.array([[1.0] + [0.0] * (
+                                 view.total.shape[1] - 1)], np.float32),
+                             np.array([2], np.int32),
+                             np.array([-1], np.int32))
+    ds2 = pol.schedule_dense(view.avail, view.total, view.alive,
+                             np.array([[1.0] + [0.0] * (
+                                 view.total.shape[1] - 1)], np.float32),
+                             np.array([2], np.int32),
+                             np.array([-1], np.int32))
+    np.testing.assert_array_equal(view.avail, before)
+    np.testing.assert_array_equal(ds1.take_sorted, ds2.take_sorted)
+    np.testing.assert_array_equal(ds1.admitted, ds2.admitted)
+
+
+def test_zero_valued_demand_entry_never_fences_or_crashes():
+    """Regression: a zero-valued resource entry (resources={'custom':
+    0}) must not divide-by-zero the hybrid fence pass, and an
+    effectively-zero demand is unbounded — never fenced."""
+    from ray_tpu._private.scheduler.policy import HybridSchedulingPolicy
+    cluster, _ = make_cluster([1])
+    reqs = [SchedulingRequest(demand={"CPU": 1, "custom": 0.0})
+            for _ in range(3)]
+    results = HybridSchedulingPolicy(seed=0).schedule_batch(cluster, reqs)
+    assert sum(1 for r in results if r.node_id is not None) == 1
+    assert sum(1 for r in results if r.is_fenced) == 2
+    allzero = [SchedulingRequest(demand={"custom": 0.0})
+               for _ in range(3)]
+    results = HybridSchedulingPolicy(seed=0).schedule_batch(
+        cluster, allzero)
+    assert all(not r.is_fenced for r in results)
+
+
+def test_fence_aggregates_across_preferred_node_classes():
+    """Regression: same-demand classes split by preferred node share
+    ONE cluster-wide totals bound — the joint surplus must fence, not
+    just each class's own overshoot."""
+    cluster, ids = make_cluster([2, 2])
+    pol = TpuSchedulingPolicy()
+    reqs = ([SchedulingRequest(demand={"CPU": 1}, preferred_node=ids[0])
+             for _ in range(5)]
+            + [SchedulingRequest(demand={"CPU": 1},
+                                 preferred_node=ids[1])
+               for _ in range(5)])
+    results = pol.schedule_batch(cluster, reqs)
+    assert sum(1 for r in results if r.node_id is not None) == 4
+    # bound 4, 10 pending: all 6 surplus fenced (per-class fencing
+    # alone would only catch 1 per class)
+    assert sum(1 for r in results if r.is_fenced) == 6
+
+
+def test_placed_equals_admitted_on_random_clusters():
+    """The fill's completeness contract (docs/scheduler.md): placed ==
+    admitted on random mixed workloads, and fenced only when the class
+    count exceeds the totals bound."""
+    rng = np.random.RandomState(7)
+    for _ in range(5):
+        n_nodes = int(rng.randint(1, 10))
+        cluster, _ = make_cluster(rng.randint(1, 12, n_nodes).tolist())
+        pol = TpuSchedulingPolicy()
+        view = pol._view
+        view.refresh(cluster, extra_resources=["CPU"])
+        k = int(rng.randint(1, 4))
+        demands = np.zeros((k, view.total.shape[1]), np.float32)
+        demands[:, view.res_index["CPU"]] = rng.randint(1, 4, k)
+        counts = rng.randint(0, 40, k).astype(np.int32)
+        prefs = np.full(k, -1, np.int32)
+        ds = pol.schedule_dense(view.avail, view.total, view.alive,
+                                demands, counts, prefs)
+        placed = (ds.local_take + ds.take_sorted.sum(axis=1)
+                  + ds.take2.sum(axis=1))
+        np.testing.assert_array_equal(placed[:k],
+                                      ds.admitted[:k])
+        assert (ds.fenced[:k] + ds.admitted[:k] <= counts).all() or \
+            (ds.fenced[:k] + ds.admitted[:k] <= counts + 1e-6).all()
