@@ -215,6 +215,43 @@ _D("fastframe_threshold_bytes", int, 16384,
    "bodies fall back to the legacy pickled-tuple frame. 0 disables "
    "the fast path.")
 
+# --- serve plane (dynamic batching + queue-aware routing +
+# backpressure-driven autoscaling; see docs/serve.md) ---
+_D("serve_max_batch_size", int, 64,
+   "Default per-dispatch batch cap for @serve.batch methods that "
+   "don't set max_batch_size themselves: the router gathers up to "
+   "this many pending requests into one vectorized replica call.")
+_D("serve_batch_wait_timeout_ms", float, 2.0,
+   "Default gather window for @serve.batch methods: once a batch "
+   "has its first request, the router waits up to this long for "
+   "more before dispatching a partial batch. A request arriving on "
+   "an idle deployment (nothing dispatched, nothing pending) "
+   "bypasses the wait entirely, so serial latency pays nothing.")
+_D("serve_max_queued_requests", int, 10000,
+   "Default bound on a deployment's total request queue (pending "
+   "batches + in-flight + admission waiters) per routing process. "
+   "Requests beyond it are shed with a retryable BackpressureError "
+   "(HTTP ingress maps it to 503 + Retry-After) instead of queueing "
+   "without limit. Per-deployment max_queued_requests overrides; "
+   "0 disables the bound.")
+_D("serve_autoscale_interval_s", float, 0.5,
+   "Cadence of serve autoscaling decisions: each interval the "
+   "controller folds a deployment's total load (queue depth + "
+   "ongoing requests) into an EWMA and resizes toward "
+   "ceil(ewma / target_ongoing_requests) within "
+   "[min_replicas, max_replicas].")
+_D("serve_autoscale_ewma_alpha", float, 0.5,
+   "Smoothing factor of the serve autoscaler's load EWMA (weight of "
+   "the newest interval sample; 1.0 = instantaneous load, the "
+   "pre-serve-plane behavior).")
+_D("serve_zero_copy_threshold_bytes", int, 65536,
+   "Request arguments at or above this size (bytes/bytearray/"
+   "ndarray) are put into the object store once at the handle and "
+   "routed as refs — each extra hop (proxy, composed handle, "
+   "batched dispatch) then moves a fixed-size id instead of "
+   "re-pickling the payload; the replica reads it zero-copy from "
+   "shm. 0 disables ref promotion.")
+
 # --- overload plane (reference: memory monitor + backpressured
 # submission; see docs/fault_tolerance.md "Overload semantics") ---
 _D("raylet_max_queued_tasks", int, 4096,

@@ -93,6 +93,23 @@ def install_runtime_metrics() -> None:
         "Idempotency dedupe-cache hit rate across raylet rpc "
         "servers (heartbeat-reported; >0 means retries/duplicate "
         "frames were collapsed)")
+    serve_rps = m.Gauge(
+        "ray_tpu_serve_rps",
+        "Serve-plane requests/s accepted by this process's routers "
+        "over the last scrape window (docs/serve.md)")
+    serve_queue = m.Gauge(
+        "ray_tpu_serve_queue_depth",
+        "Per-deployment total request queue in the driver's router: "
+        "batch-parked + in-flight + admission waiters; returns to 0 "
+        "when load stops", tag_keys=("deployment",))
+    serve_batch = m.Gauge(
+        "ray_tpu_serve_batch_size",
+        "Realized requests-per-dispatch on the serve batched path "
+        "(cumulative average)")
+    serve_replicas = m.Gauge(
+        "ray_tpu_serve_replicas",
+        "Live replicas per deployment (autoscaler-visible)",
+        tag_keys=("deployment",))
 
     def collect():
         from ray_tpu._private.worker import try_global_worker
@@ -105,8 +122,12 @@ def install_runtime_metrics() -> None:
         ng_stats = w.node_group.stats()
         # overload plane: cumulative sheds honored, plus the live
         # count of backpressured (deferred) tasks — the latter returns
-        # to zero once the overload clears
-        tasks.set(ng_stats.get("shed", 0), tags={"state": "shed"})
+        # to zero once the overload clears. Serve-plane sheds fold
+        # into the same family (docs/serve.md §Backpressure).
+        from ray_tpu._private import serve_stats
+        serve_counts = serve_stats.snapshot()
+        tasks.set(ng_stats.get("shed", 0) + serve_counts.get("shed", 0),
+                  tags={"state": "shed"})
         tasks.set(ng_stats.get("deferred", 0),
                   tags={"state": "backpressured"})
         # placement plane (docs/scheduler.md): live count of tasks the
@@ -189,5 +210,20 @@ def install_runtime_metrics() -> None:
         rpc_fastframe.set(fastframe_hits)
         rpc_dedupe_rate.set(dedupe_hits / dedupe_calls
                             if dedupe_calls else 0.0)
+        # serve plane (docs/serve.md §Observability): RPS over the
+        # scrape window, live queue depth + replica count per
+        # deployment, realized batch coalescing factor
+        serve_rps.set(serve_stats.rps_sample())
+        serve_batch.set(serve_stats.batch_avg())
+        serve_queue.clear()      # deleted deployments' series vanish
+        serve_replicas.clear()
+        for controller in serve_stats.controllers():
+            try:
+                for name, qd, nrep in controller.metrics_snapshot():
+                    serve_queue.set(qd, tags={"deployment": name})
+                    serve_replicas.set(nrep, tags={"deployment": name})
+            except Exception:  # noqa: BLE001
+                # controller mid-shutdown: skip its series this scrape
+                pass
 
     m.register_collector(collect)

@@ -30,10 +30,10 @@ from ray_tpu.devtools.analysis.core import (FileContext, Finding,
                                             suppressed_by_mark)
 
 PASS_ID = "bounded-queue"
-VERSION = 6   # v6: placement-plane modules (fence ledger, pg batch solver)
+VERSION = 7   # v7: serve plane (router/controller/proxy/replica)
 
 _SCOPES = ("_private/", "collective/", "multislice/",
-           "analysis_fixtures/")
+           "serve/", "analysis_fixtures/")
 
 _SUPPRESS_MARK = "unbounded-ok:"
 

@@ -33,10 +33,10 @@ from typing import List
 from ray_tpu.devtools.analysis.core import FileContext, Finding, attr_tail
 
 PASS_ID = "deadline-discipline"
-VERSION = 4   # v4: placement-plane modules (fence ledger, pg batch solver)
+VERSION = 5   # v5: serve plane (router/controller/proxy/replica)
 
 _SCOPES = ("_private/", "collective/", "multislice/",
-           "analysis_fixtures/")
+           "serve/", "analysis_fixtures/")
 
 _SUPPRESS_MARK = "no-deadline:"
 

@@ -29,13 +29,13 @@ from typing import List
 from ray_tpu.devtools.analysis.core import FileContext, Finding
 
 PASS_ID = "retry-discipline"
-VERSION = 5   # v5: placement-plane modules (fence ledger, pg batch solver)
+VERSION = 6   # v6: serve plane (router/controller/proxy/replica)
 
 # Enforced scopes: the runtime core, the collective/gang plane, plus
 # the lint fixture tree (the self-test floor in
 # tests/analysis_fixtures/).
 _SCOPES = ("_private/", "collective/", "multislice/",
-           "analysis_fixtures/")
+           "serve/", "analysis_fixtures/")
 
 _SUPPRESS_MARK = "no-deadline:"
 

@@ -39,13 +39,14 @@ __all__ = [
     "deployment", "run", "delete", "get_deployment_handle", "start",
     "shutdown", "status", "http_address", "AutoscalingConfig",
     "Deployment", "DeploymentHandle", "multiplexed",
-    "get_multiplexed_model_id",
+    "get_multiplexed_model_id", "batch",
 ]
 
 # Per-request model id inside a replica (model multiplexing) — the
 # ContextVar lives with the replica so workers never import this
-# package's control-plane machinery.
-from ray_tpu.serve._private.replica import _multiplex_ctx
+# package's control-plane machinery. ``batch`` is defined with the
+# replica for the same reason (the decorated body executes there).
+from ray_tpu.serve._private.replica import _multiplex_ctx, batch
 
 
 def get_multiplexed_model_id() -> Optional[str]:
@@ -131,7 +132,13 @@ def _get_controller(start_http: bool = False) -> ServeController:
 
 
 class DeploymentHandle:
-    """Client handle: routes calls through the deployment's router."""
+    """Client handle: routes calls through the deployment's router.
+
+    ``remote`` (and method calls) may raise a retryable
+    ``BackpressureError`` when the deployment's queue is at its
+    ``max_queued_requests`` bound — callers back off and retry (the
+    HTTP ingress translates it to 503 + Retry-After).
+    """
 
     def __init__(self, name: str, replica_set, _model_id=None,
                  _stream=False):
@@ -139,6 +146,10 @@ class DeploymentHandle:
         self._replica_set = replica_set
         self._model_id = _model_id
         self._stream = _stream
+        # method-proxy cache: attribute access on the hot path must
+        # not build a fresh class object per call (satellite fix) —
+        # one _Method per (handle, method_name), reused
+        self._methods = {}
 
     def remote(self, *args, **kwargs):
         return self._replica_set.assign("__call__", args, kwargs,
@@ -162,21 +173,36 @@ class DeploymentHandle:
             _stream=self._stream if stream is None else bool(stream))
 
     def method(self, method_name: str):
-        handle = self
-
-        class _Method:
-            def remote(self, *args, **kwargs):
-                return handle._replica_set.assign(
-                    method_name, args, kwargs,
-                    model_id=handle._model_id,
-                    stream=handle._stream)
-
-        return _Method()
+        cached = self._methods.get(method_name)
+        if cached is not None:
+            return cached
+        proxy = _MethodProxy(self, method_name)
+        self._methods[method_name] = proxy
+        return proxy
 
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
         return self.method(item)
+
+
+class _MethodProxy:
+    """Bound method-call proxy: ``handle.foo.remote(...)``. One
+    instance per (handle, method) — built once, cached on the handle
+    (``__getattr__`` used to mint a fresh class object per attribute
+    access on the hot path)."""
+
+    __slots__ = ("_handle", "_method")
+
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        h = self._handle
+        return h._replica_set.assign(self._method, args, kwargs,
+                                     model_id=h._model_id,
+                                     stream=h._stream)
 
 
 class Application:
@@ -194,7 +220,8 @@ class Deployment:
                  num_replicas: int, ray_actor_options: Optional[dict],
                  autoscaling_config: Optional[dict],
                  max_ongoing_requests: Optional[int] = None,
-                 graceful_shutdown_timeout_s: float = 20.0):
+                 graceful_shutdown_timeout_s: float = 20.0,
+                 max_queued_requests: Optional[int] = None):
         self._target = target
         self.name = name
         self.num_replicas = num_replicas
@@ -202,13 +229,15 @@ class Deployment:
         self.autoscaling_config = autoscaling_config
         self.max_ongoing_requests = max_ongoing_requests
         self.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
+        self.max_queued_requests = max_queued_requests
 
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[int] = None,
                 ray_actor_options: Optional[dict] = None,
                 autoscaling_config: Optional[dict] = None,
                 max_ongoing_requests: Optional[int] = None,
-                graceful_shutdown_timeout_s: Optional[float] = None
+                graceful_shutdown_timeout_s: Optional[float] = None,
+                max_queued_requests: Optional[int] = None
                 ) -> "Deployment":
         return Deployment(
             self._target,
@@ -222,7 +251,9 @@ class Deployment:
             else self.max_ongoing_requests,
             graceful_shutdown_timeout_s
             if graceful_shutdown_timeout_s is not None
-            else self.graceful_shutdown_timeout_s)
+            else self.graceful_shutdown_timeout_s,
+            max_queued_requests if max_queued_requests is not None
+            else self.max_queued_requests)
 
     def bind(self, *args, **kwargs) -> Application:
         return Application(self, args, kwargs)
@@ -233,18 +264,25 @@ def deployment(_target=None, *, name: Optional[str] = None,
                ray_actor_options: Optional[dict] = None,
                autoscaling_config: Optional[dict] = None,
                max_ongoing_requests: Optional[int] = None,
-               graceful_shutdown_timeout_s: float = 20.0):
+               graceful_shutdown_timeout_s: float = 20.0,
+               max_queued_requests: Optional[int] = None):
     """``@serve.deployment`` decorator for classes and functions.
     ``max_ongoing_requests`` caps each replica's in-flight requests
     (admission control): excess callers wait in the router instead of
-    piling onto replicas. ``graceful_shutdown_timeout_s`` bounds the
-    drain wait when a replica retires (redeploy roll or downscale)."""
+    piling onto replicas. ``max_queued_requests`` bounds the TOTAL
+    queue per routing process (pending batches + in-flight + waiters);
+    beyond it, requests shed with a retryable ``BackpressureError``
+    instead of queueing unboundedly (default: the
+    ``serve_max_queued_requests`` config knob).
+    ``graceful_shutdown_timeout_s`` bounds the drain wait when a
+    replica retires (redeploy roll or downscale)."""
 
     def wrap(target):
         return Deployment(target, name or target.__name__, num_replicas,
                           ray_actor_options, autoscaling_config,
                           max_ongoing_requests,
-                          graceful_shutdown_timeout_s)
+                          graceful_shutdown_timeout_s,
+                          max_queued_requests)
 
     if _target is not None:
         return wrap(_target)
@@ -270,7 +308,8 @@ def run(app: Union[Application, Deployment], *, name: Optional[str] = None,
         dep.num_replicas, actor_options=dep.ray_actor_options,
         autoscaling=autoscaling,
         max_ongoing_requests=dep.max_ongoing_requests,
-        graceful_shutdown_timeout_s=dep.graceful_shutdown_timeout_s)
+        graceful_shutdown_timeout_s=dep.graceful_shutdown_timeout_s,
+        max_queued_requests=dep.max_queued_requests)
     if wait_for_healthy:
         controller.wait_healthy(dep_name, timeout=timeout)
     return DeploymentHandle(dep_name, replica_set)
@@ -344,18 +383,39 @@ def http_address():
 
 
 def shutdown() -> None:
+    """Tear serve down in dependency order (docs/serve.md §Shutdown):
+
+    1. detach proxies from the controller — no more route pushes or
+       autoscale aggregation target them;
+    2. drain ingress — both proxies stop ACCEPTING and finish their
+       in-flight HTTP requests while replicas are still alive (the
+       old order killed the worker proxy while requests raced through
+       it);
+    3. stop the controller — deployments deleted, replicas drained
+       and killed;
+    4. kill the (now idle, unrouted) worker proxy actor.
+    """
     global _controller, _proxy, _worker_proxy
     with _lock:
-        if _proxy is not None:
-            _proxy.shutdown()
-            _proxy = None
-        if _worker_proxy is not None:
-            try:
-                import ray_tpu
-                ray_tpu.kill(_worker_proxy)
-            except Exception:
-                pass    # proxy actor already dead
-            _worker_proxy = None
-        if _controller is not None:
-            _controller.shutdown()
-            _controller = None
+        controller, proxy = _controller, _proxy
+        worker_proxy = _worker_proxy
+        _controller = _proxy = _worker_proxy = None
+    if controller is not None:
+        controller.detach_proxies()
+    if proxy is not None:
+        proxy.shutdown()
+    if worker_proxy is not None:
+        try:
+            import ray_tpu
+            ray_tpu.get(worker_proxy.prepare_shutdown.remote(),
+                        timeout=30)
+        except Exception:
+            pass    # proxy actor already dead / runtime torn down
+    if controller is not None:
+        controller.shutdown()
+    if worker_proxy is not None:
+        try:
+            import ray_tpu
+            ray_tpu.kill(worker_proxy)
+        except Exception:
+            pass    # proxy actor already dead

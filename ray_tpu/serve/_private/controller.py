@@ -38,6 +38,26 @@ class AutoscalingConfig:
     downscale_delay_s: float = 2.0
 
 
+def _discover_batch_cfg(target) -> dict:
+    """method name -> ``@serve.batch`` config for the router's gather
+    queues (the handle-side half of dynamic batching)."""
+    cfgs = {}
+    if isinstance(target, type):
+        for name in dir(target):
+            try:
+                attr = getattr(target, name)
+            except Exception:  # noqa: BLE001 - exotic descriptors skip
+                continue
+            cfg = getattr(attr, "_rtpu_batch_cfg", None)
+            if cfg is not None:
+                cfgs[name] = dict(cfg)
+    else:
+        cfg = getattr(target, "_rtpu_batch_cfg", None)
+        if cfg is not None:
+            cfgs["__call__"] = dict(cfg)
+    return cfgs
+
+
 @dataclass
 class DeploymentInfo:
     name: str
@@ -61,6 +81,12 @@ class DeploymentInfo:
     graceful_shutdown_timeout_s: float = 20.0
     _last_scale_change: float = 0.0
     _scale_pressure_since: Optional[float] = None
+    # backpressure-driven autoscaling state (docs/serve.md): EWMA of
+    # total load (queue depth + ongoing), evaluated every
+    # serve_autoscale_interval_s
+    _load_ewma: Optional[float] = None
+    _last_autoscale_eval: float = 0.0
+    _scale_dir: Optional[bool] = None   # True = pressure upward
 
 
 class ServeController:
@@ -85,6 +111,8 @@ class ServeController:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="rtpu-serve-controller")
         self._thread.start()
+        from ray_tpu._private import serve_stats
+        serve_stats.register_controller(self)
 
     # -- worker-hosted ingress -----------------------------------------
 
@@ -126,7 +154,8 @@ class ServeController:
                actor_options: Optional[dict] = None,
                autoscaling: Optional[AutoscalingConfig] = None,
                max_ongoing_requests: Optional[int] = None,
-               graceful_shutdown_timeout_s: float = 20.0
+               graceful_shutdown_timeout_s: float = 20.0,
+               max_queued_requests: Optional[int] = None
                ) -> ReplicaSet:
         info = DeploymentInfo(
             name=name,
@@ -153,9 +182,11 @@ class ServeController:
                 info.replicas = list(old.replicas)
             self._deployments[name] = info
             # inside the lock and after the old-set swap: a concurrent
-            # redeploy must not leave the superseded deploy's cap on
-            # the shared replica set
+            # redeploy must not leave the superseded deploy's cap,
+            # queue bound, or batch table on the shared replica set
             info.replica_set.max_ongoing = max_ongoing_requests
+            info.replica_set.max_queued = max_queued_requests
+            info.replica_set.batch_cfg = _discover_batch_cfg(target)
         self._reconcile_once()
         return info.replica_set
 
@@ -170,6 +201,10 @@ class ServeController:
                 self._pushed_routes.pop(name, None)
                 proxies = list(self._proxies)
             if info is not None:
+                # fail parked batched requests typed BEFORE replicas
+                # die (their dispatches would fail anyway; this is the
+                # deterministic path) and stop the flusher
+                info.replica_set.close()
                 self._kill_replicas(info.replicas)
                 info.replica_set.set_replicas([])
                 for proxy in proxies:
@@ -191,6 +226,7 @@ class ServeController:
                     "target_replicas": info.num_replicas,
                     "live_replicas": len(info.replicas),
                     "ongoing_requests": info.replica_set.total_inflight(),
+                    "queued_requests": info.replica_set.total_queued(),
                     "generation": info.generation,
                     "updating": any(
                         getattr(r, "_serve_gen", info.generation)
@@ -211,6 +247,22 @@ class ServeController:
                     return
             time.sleep(0.05)
         raise TimeoutError(f"deployment {name!r} never became healthy")
+
+    def metrics_snapshot(self):
+        """[(deployment, queue_depth, live_replicas), ...] for the
+        runtime metrics collector (stats.py serve gauges)."""
+        with self._lock:
+            infos = list(self._deployments.values())
+        return [(info.name, info.replica_set.total_queued(),
+                 len(info.replicas)) for info in infos]
+
+    def detach_proxies(self) -> None:
+        """Stop routing to the worker-hosted proxies (serve.shutdown
+        step 1): no further route pushes or autoscale aggregation —
+        the proxies can then drain and be killed without racing a
+        controller push."""
+        with self._lock:
+            self._proxies = []
 
     def shutdown(self) -> None:
         self._shutdown.set()
@@ -373,31 +425,51 @@ class ServeController:
         return total
 
     def _autoscale(self, info: DeploymentInfo) -> None:
+        """Backpressure-driven autoscaling (docs/serve.md): every
+        ``serve_autoscale_interval_s`` fold the deployment's TOTAL
+        load — queue depth (batch-parked + admission waiters) plus
+        ongoing requests, proxies included — into an EWMA and steer
+        the target straight to ``ceil(ewma / target_ongoing_requests)``
+        within [min_replicas, max_replicas]. Direction changes reset
+        the up/downscale delay; scale-down victims drain through the
+        existing graceful-shutdown path."""
+        import math
+
+        from ray_tpu._private.config import get_config
         cfg = info.autoscaling
-        ongoing = info.replica_set.total_inflight()
-        if self._proxies:
-            ongoing += self._proxy_ongoing(info.name)
-        current = max(len(info.replicas), 1)
-        per_replica = ongoing / current
+        rcfg = get_config()
         now = time.monotonic()
-        want = info.num_replicas
-        if per_replica > cfg.target_ongoing_requests:
-            if info._scale_pressure_since is None:
-                info._scale_pressure_since = now
-            if now - info._scale_pressure_since >= cfg.upscale_delay_s:
-                want = min(current + 1, cfg.max_replicas)
-        elif per_replica < cfg.target_ongoing_requests * 0.5:
-            if info._scale_pressure_since is None:
-                info._scale_pressure_since = now
-            if now - info._scale_pressure_since >= cfg.downscale_delay_s:
-                want = max(current - 1, cfg.min_replicas)
-        else:
+        if now - info._last_autoscale_eval < rcfg.serve_autoscale_interval_s:
+            return
+        info._last_autoscale_eval = now
+        load = info.replica_set.total_queued()
+        if self._proxies:
+            load += self._proxy_ongoing(info.name)
+        alpha = min(1.0, max(0.0, rcfg.serve_autoscale_ewma_alpha))
+        info._load_ewma = (float(load) if info._load_ewma is None
+                           else alpha * load
+                           + (1.0 - alpha) * info._load_ewma)
+        target = max(cfg.target_ongoing_requests, 1e-9)
+        desired = int(math.ceil(info._load_ewma / target))
+        desired = min(max(desired, cfg.min_replicas), cfg.max_replicas)
+        if desired == info.num_replicas:
             info._scale_pressure_since = None
-        if want != info.num_replicas:
-            logger.info("serve %s: autoscale %d -> %d (ongoing=%d)",
-                        info.name, info.num_replicas, want, ongoing)
-            info.num_replicas = want
-            info._scale_pressure_since = None
+            info._scale_dir = None
+            return
+        up = desired > info.num_replicas
+        if info._scale_pressure_since is None or info._scale_dir != up:
+            info._scale_pressure_since = now
+            info._scale_dir = up
+            return
+        delay = cfg.upscale_delay_s if up else cfg.downscale_delay_s
+        if now - info._scale_pressure_since < delay:
+            return
+        logger.info("serve %s: autoscale %d -> %d (load=%d ewma=%.1f)",
+                    info.name, info.num_replicas, desired, load,
+                    info._load_ewma)
+        info.num_replicas = desired
+        info._scale_pressure_since = None
+        info._scale_dir = None
 
     # -- replica lifecycle ---------------------------------------------
 

@@ -13,6 +13,18 @@ Two placements share one handler:
   off the driver's threads, and the controller pushes route-table
   updates to it as replica membership changes.
 
+Overload (docs/serve.md): a shed at the router — the deployment's
+queue hit ``max_queued_requests`` — surfaces as the PR-3
+``BackpressureError``; the handler maps it to **503 + Retry-After**
+so well-behaved clients back off instead of hammering a saturated
+tier.
+
+Shutdown is deterministic: both placements count in-flight requests
+and ``shutdown``/``prepare_shutdown`` stop the listener, then wait
+(bounded) for that count to drain before closing the socket — an
+in-flight request races neither the socket teardown nor (for the
+worker proxy) the ``ray_tpu.kill``.
+
 Streaming: ``POST /<deployment>?stream=1`` (or the
 ``X-RTPU-Stream: 1`` header / ``Accept: text/event-stream``) responds
 with chunked transfer encoding — one JSON line per yielded item,
@@ -24,10 +36,47 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 logger = logging.getLogger(__name__)
+
+
+class _CountingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that tracks in-flight request handlers so
+    shutdown can drain them deterministically."""
+
+    daemon_threads = True
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    def request_entered(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+
+    def request_left(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def drain(self, timeout_s: float = 10.0) -> int:
+        """Stop accepting, then wait (bounded) for in-flight handlers
+        to finish. Returns the count still running at the deadline
+        (0 = fully drained)."""
+        self.shutdown()           # serve_forever exits; no new accepts
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.inflight() == 0:
+                return 0
+            time.sleep(0.02)
+        return self.inflight()
 
 
 def _make_handler(get_replica_set: Callable[[str], Optional[object]],
@@ -35,12 +84,33 @@ def _make_handler(get_replica_set: Callable[[str], Optional[object]],
     """One handler class over any route-table source (controller in the
     driver, pushed table in a proxy worker)."""
     import ray_tpu
+    from ray_tpu.exceptions import BackpressureError
 
     class _Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # NOTE: no socket timeout — it would also reset a slow client
+        # mid-upload. Idle keep-alive handler threads are daemon and
+        # do not count as in-flight (only active processing does), so
+        # the shutdown drain never waits on them.
 
         def log_message(self, *a):  # noqa: ANN002 - silence stdlib
             pass
+
+        def do_POST(self):  # noqa: N802 - stdlib naming
+            # count only ACTIVE processing (not keep-alive idling
+            # between requests): the drain in shutdown() waits on this
+            self.server.request_entered()
+            try:
+                self._do_post_inner()
+            finally:
+                self.server.request_left()
+
+        def do_GET(self):  # noqa: N802
+            self.server.request_entered()
+            try:
+                self._do_get_inner()
+            finally:
+                self.server.request_left()
 
         def _wants_stream(self) -> bool:
             if "stream=1" in (self.path.partition("?")[2] or ""):
@@ -49,7 +119,25 @@ def _make_handler(get_replica_set: Callable[[str], Optional[object]],
                 return True
             return "text/event-stream" in self.headers.get("Accept", "")
 
-        def do_POST(self):  # noqa: N802 - stdlib naming
+        def _send_503(self, e: BackpressureError) -> None:
+            """Shed: the deployment's queue is at max_queued_requests.
+            Retry-After carries the router's backoff hint so clients
+            space their retries (docs/serve.md §Backpressure)."""
+            blob = json.dumps({
+                "error": "backpressure",
+                "retryable": bool(getattr(e, "retryable", True)),
+                "detail": str(e)[:500],
+            }).encode()
+            self.send_response(503)
+            retry_after = max(1, int(round(
+                getattr(e, "backoff_s", 0.0) or 1.0)))
+            self.send_header("Retry-After", str(retry_after))
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def _do_post_inner(self):
             path = self.path.partition("?")[0]
             name = path.strip("/").split("/")[0]
             replica_set = get_replica_set(name)
@@ -71,6 +159,9 @@ def _make_handler(get_replica_set: Callable[[str], Optional[object]],
                     return
                 ref = replica_set.assign("__call__", args, {})
                 result = ray_tpu.get(ref, timeout=120)
+            except BackpressureError as e:
+                self._send_503(e)
+                return
             except Exception as e:  # noqa: BLE001 - surfaces as 500
                 self.send_error(500, str(e)[:500])
                 return
@@ -106,7 +197,7 @@ def _make_handler(get_replica_set: Callable[[str], Optional[object]],
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
 
-        def do_GET(self):  # noqa: N802
+        def _do_get_inner(self):
             if self.path.rstrip("/") in ("", "/-", "/-/routes"):
                 blob = json.dumps(status_fn()).encode()
                 self.send_response(200)
@@ -115,7 +206,7 @@ def _make_handler(get_replica_set: Callable[[str], Optional[object]],
                 self.end_headers()
                 self.wfile.write(blob)
             else:
-                self.do_POST()
+                self._do_post_inner()
 
     return _Handler
 
@@ -127,16 +218,25 @@ class HttpProxy:
         self._controller = controller
         handler = _make_handler(controller.get_replica_set,
                                 controller.status)
-        self._server = ThreadingHTTPServer((host, port), handler)
+        self._server = _CountingHTTPServer((host, port), handler)
         self.address = self._server.server_address
         self._thread = threading.Thread(
             target=self._server.serve_forever, kwargs={"poll_interval": 0.1},
             daemon=True, name="rtpu-serve-http")
         self._thread.start()
 
-    def shutdown(self) -> None:
+    def shutdown(self, drain_timeout_s: float = 10.0) -> None:
+        """Deterministic teardown: stop accepting, join the listener
+        thread, DRAIN in-flight handlers (bounded), then close the
+        socket — a request in flight during shutdown gets its response
+        instead of a reset socket."""
         try:
-            self._server.shutdown()
+            left = self._server.drain(drain_timeout_s)
+            if left:
+                logger.warning(
+                    "http proxy closed with %d requests still in "
+                    "flight after %.0fs drain", left, drain_timeout_s)
+            self._thread.join(timeout=5)
             self._server.server_close()
         except Exception:
             pass    # double-shutdown / already-closed socket
@@ -154,7 +254,7 @@ class ProxyActor:
         self._routes = {}            # name -> ReplicaSet snapshot
         self._lock = threading.Lock()
         handler = _make_handler(self._get_replica_set, self._status)
-        self._server = ThreadingHTTPServer((host, port), handler)
+        self._server = _CountingHTTPServer((host, port), handler)
         self._addr = self._server.server_address
         self._thread = threading.Thread(
             target=self._server.serve_forever,
@@ -190,6 +290,19 @@ class ProxyActor:
             else:
                 self._routes[name] = replica_set
         return "ok"
+
+    def prepare_shutdown(self, drain_timeout_s: float = 10.0) -> int:
+        """serve.shutdown step 2: stop accepting and drain in-flight
+        HTTP requests while replicas are still alive — the subsequent
+        ``ray_tpu.kill`` then hits an idle actor, never a request in
+        flight. Returns how many handlers were still running at the
+        drain deadline (0 = clean)."""
+        left = self._server.drain(drain_timeout_s)
+        try:
+            self._server.server_close()
+        except Exception:  # noqa: BLE001
+            pass    # socket already closed
+        return left
 
     def address(self):
         return tuple(self._addr)
