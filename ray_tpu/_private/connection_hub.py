@@ -46,6 +46,9 @@ class ConnectionHub:
             except (EOFError, OSError):
                 conn.close()
                 continue
+            # wire-shape-ok: this is the workers' unix-socket hub —
+            # multiprocessing.Connection speaks pickle end to end and
+            # never negotiates RTF1, so tuples survive the trip
             if not (isinstance(msg, tuple) and msg[0] == "register"):
                 conn.close()
                 continue

@@ -48,6 +48,9 @@ def load_library(name: str, sources, extra_flags=()) -> Optional[
                 cmd = ["g++", "-O3", "-march=native", "-std=c++17",
                        "-shared", "-fPIC", *extra_flags,
                        *srcs, "-o", so_path + ".tmp"]
+                # blocking-ok: one-time compile at first use; the lock
+                # IS the build serialization — concurrent callers must
+                # wait for the single .so rather than race the compiler
                 subprocess.run(cmd, check=True, capture_output=True,
                                cwd=_NATIVE_DIR)
                 os.rename(so_path + ".tmp", so_path)

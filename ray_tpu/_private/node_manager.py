@@ -267,6 +267,11 @@ class NodeManagerGroup:
         self._ensure_host_copy_cb = None  # (ObjectID) -> (name, size)|None
         self._stream_item_cb = None  # (TaskID, results); set by Worker
 
+        # Scheduling state lock. The dependency manager is a leaf:
+        # its lock may be taken inside _lock (dispatch consults
+        # readiness) but it never calls back up into the group
+        # (enforced by graftcheck's lock-order pass):
+        # lock-order: _lock -> DependencyManager._lock
         self._lock = threading.RLock()
         self._raylets: Dict[NodeID, Raylet] = {}  # guarded-by: _lock
         self._remote_nodes: Dict[NodeID, RemoteNodeHandle] = {}  # guarded-by: _lock

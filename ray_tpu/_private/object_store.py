@@ -296,6 +296,9 @@ class ShmStore:
         # object, which lineage reconstruction recovers (tier-1
         # test_reconstruct_lost_spill_file); fsync here would sit on
         # the store's eviction path
+        # blocking-ok: spill IS the make-room path — it must complete
+        # atomically with the segment/size-table updates around it, or
+        # a concurrent create would double-evict into the same hole
         with open(path, "wb") as f:
             f.write(seg.buf[:size])
         seg.unlink()

@@ -145,8 +145,9 @@ class RayletServer:
         # flush-ahead topic (e.g. an actor_ckpt commit) observe an
         # empty buffer while the drained completions it must trail are
         # still unsent in another thread — the commit would overtake
-        # its completions on the wire. Lock order: _push_order_lock ->
-        # _push_lock -> (ctx._send_lock inside push); never reversed.
+        # its completions on the wire. Never reversed (graftcheck's
+        # lock-order pass enforces the declaration below):
+        # lock-order: _push_order_lock -> _push_lock -> ConnectionContext._send_lock
         self._push_order_lock = threading.Lock()
         self._push_armed = threading.Event()
         self._last_push_ts = 0.0  # guarded-by: _push_lock

@@ -508,6 +508,10 @@ class RpcServer:
             return refuse(str(e))
         except (ConnectionError, OSError, EOFError):
             return False
+        # wire-shape-ok: the hello precedes fastframe negotiation, so
+        # it can only arrive on the legacy pickled frame — and even a
+        # fast frame's OUTER shape is re-tupled by _recv_frame; only
+        # NESTED values keep msgpack's list normalization
         if not (isinstance(msg, tuple) and len(msg) in (3, 4)
                 and msg[0] == "hello"):
             return refuse("expected hello handshake frame")

@@ -175,100 +175,114 @@ class TaskManager:
         """``results``: [(oid_bytes, kind, data, contained_ref_bytes)].
         ``error_blob``: serialized TaskError (app-level).
         ``system_error``: worker crash etc. — always retryable."""
+        # The retry decision runs under _lock; the resubmit callback
+        # runs AFTER it releases. _resubmit (Worker) takes _actor_lock,
+        # and _actor_lock holders call back into this manager
+        # (_resubmit -> _fail_task -> mark_failed_external), so calling
+        # out while holding _lock nests the two locks in both orders —
+        # the AB/BA deadlock the lock-order pass exists to catch.
         with self._lock:
-            rec = self._tasks.get(task_id)
-            if rec is None:
-                return
-            if error_blob is None and system_error is None:
-                self._mark_terminal(rec, "finished")
-                self.num_finished += 1
-                self._release_args(rec)
-                # a lineage re-run of this spec starts OOM backoff fresh
-                rec.spec._oom_backoff_s = 0.0  # type: ignore[attr-defined]
-                kind_map = {"inline": "blob", "shm": "shm",
-                            "remote": "remote"}
-                for oid_b, kind, data, contained in results:
-                    entry = Entry(
-                        kind_map[kind], data,
-                        tuple(_contained_item(c) for c in contained))
-                    self._store_result(ObjectID(oid_b), entry)
-                return
-            # failure path
-            if rec.cancelled:
-                # cancelled: terminal, no retry, canonical error
-                from ray_tpu.exceptions import TaskCancelledError
-                self._mark_terminal(rec, "failed")
-                self.num_failed += 1
-                self._release_args(rec)
-                blob = serialization.get_context().serialize(
-                    TaskCancelledError(
-                        f"task {rec.spec.repr_name()} was cancelled"
-                    )).to_bytes()
-                for oid in rec.spec.return_ids:
-                    self._store_result(oid, Entry("err", blob))
-                return
-            if isinstance(system_error, OutOfMemoryError):
-                # Memory-watchdog kill: its own retry budget
-                # (task_oom_retries) with exponential backoff; a
-                # non-retryable victim surfaces the typed error.
-                self.num_oom_kills += 1
-                if system_error.retryable and rec.oom_retries_left > 0:
-                    from ray_tpu._private.backoff import (jittered,
-                                                          next_backoff)
-                    from ray_tpu._private.config import get_config
-                    cfg = get_config()
-                    rec.oom_retries_left -= 1
-                    rec.attempt += 1
-                    rec.status = "pending"
-                    self.num_retries += 1
-                    self.num_oom_retries += 1
-                    # shared shed-retry schedule: doubling, capped,
-                    # jittered (a raylet under real memory pressure
-                    # evicts MANY tasks at once — they must not all
-                    # come back in the same tick)
-                    nxt = next_backoff(
-                        getattr(rec.spec, "_oom_backoff_s", 0.0),
-                        cfg.backpressure_retry_base_ms / 1000.0,
-                        cfg.backpressure_retry_max_ms / 1000.0,
-                        hint_s=system_error.backoff_s)
-                    rec.spec._oom_backoff_s = nxt  # type: ignore[attr-defined]
-                    rec.spec._resubmit_delay_s = jittered(  # type: ignore[attr-defined]
-                        nxt, self._backoff_rng)
-                    self._resubmit(rec.spec)
-                    return
-                self._mark_terminal(rec, "failed")
-                self.num_failed += 1
-                self._release_args(rec)
-                blob = serialization.get_context().serialize(
-                    system_error).to_bytes()
-                for oid in rec.spec.return_ids:
-                    self._store_result(oid, Entry("err", blob))
-                return
-            retryable = system_error is not None
-            if error_blob is not None and rec.spec.retry_exceptions:
-                retryable = self._error_matches(
-                    error_blob, rec.spec.retry_exceptions)
-            if retryable and rec.retries_left > 0:
-                rec.retries_left -= 1
-                rec.attempt += 1
-                rec.status = "pending"
-                self.num_retries += 1
-                self._resubmit(rec.spec)
-                return
+            resubmit_spec = self._complete_locked(
+                task_id, results, error_blob, system_error)
+        if resubmit_spec is not None:
+            self._resubmit(resubmit_spec)
+
+    # lock-held: _lock
+    def _complete_locked(self, task_id, results, error_blob,
+                         system_error):
+        """Terminal-state bookkeeping; returns the spec to resubmit
+        (caller invokes the callback outside the lock) or None."""
+        rec = self._tasks.get(task_id)
+        if rec is None:
+            return None
+        if error_blob is None and system_error is None:
+            self._mark_terminal(rec, "finished")
+            self.num_finished += 1
+            self._release_args(rec)
+            # a lineage re-run of this spec starts OOM backoff fresh
+            rec.spec._oom_backoff_s = 0.0  # type: ignore[attr-defined]
+            kind_map = {"inline": "blob", "shm": "shm",
+                        "remote": "remote"}
+            for oid_b, kind, data, contained in results:
+                entry = Entry(
+                    kind_map[kind], data,
+                    tuple(_contained_item(c) for c in contained))
+                self._store_result(ObjectID(oid_b), entry)
+            return None
+        # failure path
+        if rec.cancelled:
+            # cancelled: terminal, no retry, canonical error
+            from ray_tpu.exceptions import TaskCancelledError
             self._mark_terminal(rec, "failed")
             self.num_failed += 1
             self._release_args(rec)
-            if error_blob is None:
-                from ray_tpu.exceptions import RayTpuError
-                if isinstance(system_error, RayTpuError):
-                    err: BaseException = system_error
-                else:
-                    err = TaskError(
-                        system_error, rec.spec.repr_name(),
-                        f"{type(system_error).__name__}: {system_error}")
-                error_blob = serialization.get_context().serialize(err).to_bytes()
+            blob = serialization.get_context().serialize(
+                TaskCancelledError(
+                    f"task {rec.spec.repr_name()} was cancelled"
+                )).to_bytes()
             for oid in rec.spec.return_ids:
-                self._store_result(oid, Entry("err", error_blob))
+                self._store_result(oid, Entry("err", blob))
+            return None
+        if isinstance(system_error, OutOfMemoryError):
+            # Memory-watchdog kill: its own retry budget
+            # (task_oom_retries) with exponential backoff; a
+            # non-retryable victim surfaces the typed error.
+            self.num_oom_kills += 1
+            if system_error.retryable and rec.oom_retries_left > 0:
+                from ray_tpu._private.backoff import (jittered,
+                                                      next_backoff)
+                from ray_tpu._private.config import get_config
+                cfg = get_config()
+                rec.oom_retries_left -= 1
+                rec.attempt += 1
+                rec.status = "pending"
+                self.num_retries += 1
+                self.num_oom_retries += 1
+                # shared shed-retry schedule: doubling, capped,
+                # jittered (a raylet under real memory pressure
+                # evicts MANY tasks at once — they must not all
+                # come back in the same tick)
+                nxt = next_backoff(
+                    getattr(rec.spec, "_oom_backoff_s", 0.0),
+                    cfg.backpressure_retry_base_ms / 1000.0,
+                    cfg.backpressure_retry_max_ms / 1000.0,
+                    hint_s=system_error.backoff_s)
+                rec.spec._oom_backoff_s = nxt  # type: ignore[attr-defined]
+                rec.spec._resubmit_delay_s = jittered(  # type: ignore[attr-defined]
+                    nxt, self._backoff_rng)
+                return rec.spec
+            self._mark_terminal(rec, "failed")
+            self.num_failed += 1
+            self._release_args(rec)
+            blob = serialization.get_context().serialize(
+                system_error).to_bytes()
+            for oid in rec.spec.return_ids:
+                self._store_result(oid, Entry("err", blob))
+            return None
+        retryable = system_error is not None
+        if error_blob is not None and rec.spec.retry_exceptions:
+            retryable = self._error_matches(
+                error_blob, rec.spec.retry_exceptions)
+        if retryable and rec.retries_left > 0:
+            rec.retries_left -= 1
+            rec.attempt += 1
+            rec.status = "pending"
+            self.num_retries += 1
+            return rec.spec
+        self._mark_terminal(rec, "failed")
+        self.num_failed += 1
+        self._release_args(rec)
+        if error_blob is None:
+            from ray_tpu.exceptions import RayTpuError
+            if isinstance(system_error, RayTpuError):
+                err: BaseException = system_error
+            else:
+                err = TaskError(
+                    system_error, rec.spec.repr_name(),
+                    f"{type(system_error).__name__}: {system_error}")
+            error_blob = serialization.get_context().serialize(err).to_bytes()
+        for oid in rec.spec.return_ids:
+            self._store_result(oid, Entry("err", error_blob))
 
     def mark_failed_external(self, task_id: TaskID) -> None:
         """Record an OUT-OF-BAND terminal failure — the caller stored

@@ -307,7 +307,11 @@ class Worker:
         # kill/restart race) — checked before any restart/revival
         self._actor_tombstones: set = set()  # guarded-by: _actor_lock
         # collective gangs (coordinated SPMD restart; see
-        # docs/fault_tolerance.md "Gang semantics")
+        # docs/fault_tolerance.md "Gang semantics"). Gang teardown
+        # snapshots membership under _gang_lock then fails the member
+        # queues under _actor_lock inside it — never the reverse
+        # nesting (enforced by graftcheck's lock-order pass):
+        # lock-order: _gang_lock -> _actor_lock
         self._gang_lock = threading.Lock()
         self._gangs: Dict[str, _GangRecord] = {}  # guarded-by: _gang_lock
         self._actor_gang: Dict[ActorID, str] = {}  # guarded-by: _gang_lock

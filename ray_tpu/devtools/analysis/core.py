@@ -1,29 +1,49 @@
-"""graftcheck core: pass-runner over ``ast`` with per-file caching and
-a JSON baseline-suppression file.
+"""graftcheck core: two-phase pass-runner over ``ast`` with per-file
+caching and a JSON baseline-suppression file.
 
 The runtime under ``ray_tpu/_private`` is a layered concurrent system
 (raylet scheduling loops, worker pools, an object store, an RPC mesh);
 every class of advisor finding so far — unlocked mutations, state
-recorded before an RPC outcome is known, client/server RPC drift — is
-statically detectable. This framework turns those one-off catches into
-a permanent ratchet: eight passes (see ``passes/``) run over the tree,
-unsuppressed findings fail the build (tier-1 runs the suite via
-``tests/test_static_analysis.py``).
+recorded before an RPC outcome is known, client/server RPC drift,
+lock-order inversions, blocking work under a lock, tuple-only gates on
+fastframe-normalized values — is statically detectable. This framework
+turns those one-off catches into a permanent ratchet: twelve passes
+(see ``passes/``) run over the tree, unsuppressed findings fail the
+build (tier-1 runs the suite via ``tests/test_static_analysis.py``).
+
+Execution is two-phase (graftcheck v2):
+
+- **Phase 1** (per file, cached on mtime/size/version): the per-file
+  passes run, and ``callgraph.summarize_file`` distills the file into
+  a whole-program summary (functions, call edges, lock acquisitions,
+  blocking sites, type gates, annotations). Both land in one cache
+  entry, so a warm run re-parses nothing.
+- **Phase 2** (whole program, always re-run): the summaries are linked
+  into a project call graph and the cross-file passes run over it.
+  Because phase 2 recomputes from the freshest summaries every run,
+  editing file A invalidates any cross-file finding whose evidence
+  spans A and B even when B's summary is cache-hit.
 
 Pass protocol — a pass module exposes:
 
 - ``PASS_ID``: short kebab-case name, stable across versions.
 - ``VERSION``: int; bumping it invalidates cached findings.
-- ``check_file(ctx) -> list[Finding]``   (per-file pass, cacheable), or
-- ``check_project(ctxs) -> list[Finding]`` (cross-file pass, e.g. the
-  rpc-surface table cross-check; always re-run, never cached).
+- ``check_file(ctx) -> list[Finding]``   (phase-1 pass, cacheable), or
+- ``check_graph(graph) -> list[Finding]`` (phase-2 pass over the
+  linked ``callgraph.ProjectGraph``; always re-run, never cached), or
+- ``check_project(ctxs) -> list[Finding]`` (legacy cross-file pass
+  over raw FileContexts; forces a parse of every scanned file).
 
 Suppression is two-level: a fingerprint baseline (``baseline.json``
 next to this module, regenerated with ``--update-baseline``) for
 accepted legacy findings, and inline source conventions documented per
-pass (``# guarded-by:``, ``# lock-held:``, ``# rpc: external``).
+pass (``# guarded-by:``, ``# lock-held:``, ``# rpc: external``,
+``# lock-order:``, ``# blocking-ok:``, ``# wire-shape-ok:``).
 Fingerprints hash (pass, path, enclosing scope, message) — NOT line
 numbers — so unrelated edits above a finding don't unsuppress it.
+Baselined findings that stop firing are *pruned*: ``run_analysis``
+reports and removes them when ``prune_stale`` is set (the CLI sets it
+on every full-suite run), so the suppression file cannot silently rot.
 """
 
 from __future__ import annotations
@@ -228,6 +248,9 @@ class Baseline:
                 e for e in self.entries.values()
                 if e["path"] not in scanned_paths
                 and e["fingerprint"] not in fresh)
+        self._dump(entries)
+
+    def _dump(self, entries) -> None:
         data = {
             "comment": ("graftcheck baseline: accepted findings, keyed "
                         "by fingerprint. Regenerate with `python -m "
@@ -240,10 +263,32 @@ class Baseline:
             json.dump(data, f, indent=1, sort_keys=True)
             f.write("\n")
 
+    def prune(self, current: List[Finding],
+              scanned_paths: set) -> List[dict]:
+        """Drop (and return) entries that no longer fire: their path
+        was fully scanned this run but their fingerprint produced no
+        finding. A stale suppression is debt — the accepted problem
+        was fixed, and keeping the entry would silently re-admit an
+        identical future regression as 'already accepted'. Only paths
+        whose PER-FILE findings were in this run's report may be
+        judged — link-only files surface just their phase-2 findings,
+        and pruning on that partial view would delete valid
+        suppressions."""
+        live = {f.fingerprint() for f in current}
+        stale = [e for e in self.entries.values()
+                 if e["path"] in scanned_paths
+                 and e["fingerprint"] not in live]
+        if stale:
+            for e in stale:
+                del self.entries[e["fingerprint"]]
+            self._dump(list(self.entries.values()))
+        return stale
+
 
 class FileCache:
-    """Per-file findings cache for the per-file passes, keyed on
-    (mtime, size, passes-version). Cross-file passes never cache."""
+    """Phase-1 cache: per-file findings AND the file's whole-program
+    summary, keyed on (mtime, size, passes-version). Phase-2 passes
+    never cache — they relink the summaries every run."""
 
     def __init__(self, path: str, version_tag: str):
         self.path = path
@@ -266,18 +311,24 @@ class FileCache:
             return None
         return [st.st_mtime, st.st_size]
 
-    def get(self, abspath: str) -> Optional[List[Finding]]:
+    def get(self, abspath: str) -> Optional[tuple]:
+        """(findings, summary) on a fresh hit, else None."""
         entry = self.data.get(abspath)
         if entry is None or entry.get("stat") != self._stat_key(abspath):
             return None
-        return [Finding.from_json(d) for d in entry["findings"]]
+        if "summary" not in entry:
+            return None
+        return ([Finding.from_json(d) for d in entry["findings"]],
+                entry["summary"])
 
-    def put(self, abspath: str, findings: List[Finding]) -> None:
+    def put(self, abspath: str, findings: List[Finding],
+            summary: dict) -> None:
         stat = self._stat_key(abspath)
         if stat is None:
             return
         self.data[abspath] = {"stat": stat,
-                              "findings": [f.to_json() for f in findings]}
+                              "findings": [f.to_json() for f in findings],
+                              "summary": summary}
         self.dirty = True
 
     def save(self) -> None:
@@ -301,12 +352,35 @@ def run_analysis(paths: Sequence[str],
                  baseline_path: Optional[str] = None,
                  use_cache: bool = True,
                  update_baseline: bool = False,
-                 pass_ids: Optional[Sequence[str]] = None):
+                 pass_ids: Optional[Sequence[str]] = None,
+                 link_paths: Optional[Sequence[str]] = None,
+                 prune_stale: bool = False,
+                 report: Optional[dict] = None):
     """Run the suite; returns (unsuppressed, all_findings).
 
     ``root`` anchors repo-relative paths (and fingerprints); default is
     the repository root inferred from this package's location.
+
+    ``link_paths`` extends the *whole-program link set* beyond the
+    scanned ``paths``: their summaries feed phase 2 (from the cache
+    when fresh, re-summarized when not), but their phase-1 findings
+    are not reported — this is how ``--changed`` scans an edited
+    subset while the cross-file passes still see the entire program.
+
+    ``prune_stale`` drops baseline entries that no longer fire (path
+    in the SCANNED set this run, fingerprint absent); the removed
+    entries land in ``report["stale_pruned"]``. Only a full-suite run
+    may prune — a restricted ``--pass`` scan sees a slice of the
+    findings, and link-only files surface just their phase-2
+    findings, so neither may judge a suppression stale.
+
+    ``report``, when a dict, is filled with run metadata:
+    ``timings`` (pass id -> seconds, plus ``parse+summarize``) and
+    ``stale_pruned``.
     """
+    import time as _time
+
+    from ray_tpu.devtools.analysis import callgraph
     from ray_tpu.devtools.analysis.passes import load_passes
 
     passes = load_passes()
@@ -330,33 +404,72 @@ def run_analysis(paths: Sequence[str],
             os.path.dirname(os.path.abspath(__file__)))))
 
     version_tag = ",".join(
-        f"{p.PASS_ID}={getattr(p, 'VERSION', 0)}" for p in passes)
+        [f"summary={callgraph.SUMMARY_VERSION}"]
+        + [f"{p.PASS_ID}={getattr(p, 'VERSION', 0)}" for p in passes])
     cache = FileCache(os.path.join(root, CACHE_BASENAME) if use_cache
                       else "", version_tag)
 
     file_passes = [p for p in passes if hasattr(p, "check_file")]
+    graph_passes = [p for p in passes if hasattr(p, "check_graph")]
     project_passes = [p for p in passes if hasattr(p, "check_project")]
 
-    # Files are always parsed (the cross-file passes need every AST);
-    # the cache only short-circuits the per-file passes, which dominate.
+    timings: Dict[str, float] = {}
+
+    def timed(key: str, fn):
+        t0 = _time.perf_counter()
+        out = fn()
+        timings[key] = timings.get(key, 0.0) \
+            + (_time.perf_counter() - t0)
+        return out
+
+    scan_files = collect_files(paths)
+    scan_set = set(scan_files)
+    all_files = list(scan_files)
+    if link_paths:
+        all_files += [f for f in collect_files(link_paths)
+                      if f not in scan_set]
+
+    # Phase 1: per-file passes + summaries, cache-first. A cache hit
+    # skips the parse entirely; legacy check_project passes (none in
+    # the standard suite) force parsing of the scanned files.
     findings: List[Finding] = []
+    summaries: Dict[str, dict] = {}
     ctxs: List[FileContext] = []
-    for abspath in collect_files(paths):
-        ctx = parse_file(abspath, root)
-        if ctx is None:
-            continue
-        ctxs.append(ctx)
-        cached = cache.get(abspath)
+    scanned_rel: set = set()
+    for abspath in all_files:
+        in_scan = abspath in scan_set
+        cached = None if project_passes and in_scan \
+            else cache.get(abspath)
         if cached is not None:
-            findings.extend(cached)
-            continue
-        file_findings: List[Finding] = []
-        for p in file_passes:
-            file_findings.extend(p.check_file(ctx))
-        cache.put(abspath, file_findings)
-        findings.extend(file_findings)
+            file_findings, summary = cached
+        else:
+            ctx = timed("parse+summarize", lambda: parse_file(abspath,
+                                                              root))
+            if ctx is None:
+                continue
+            if project_passes and in_scan:
+                ctxs.append(ctx)
+            file_findings = []
+            for p in file_passes:
+                timed(p.PASS_ID,
+                      lambda p=p: file_findings.extend(p.check_file(ctx)))
+            summary = timed("parse+summarize",
+                            lambda: callgraph.summarize_file(ctx))
+            cache.put(abspath, file_findings, summary)
+        summaries[summary["path"]] = summary
+        if in_scan:
+            scanned_rel.add(summary["path"])
+            findings.extend(file_findings)
+
+    # Phase 2: link and run the whole-program passes.
+    graph = timed("parse+summarize",
+                  lambda: callgraph.build_graph(summaries))
+    for p in graph_passes:
+        timed(p.PASS_ID,
+              lambda p=p: findings.extend(p.check_graph(graph)))
     for p in project_passes:
-        findings.extend(p.check_project(ctxs))
+        timed(p.PASS_ID,
+              lambda p=p: findings.extend(p.check_project(ctxs)))
     cache.save()
 
     findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
@@ -369,10 +482,15 @@ def run_analysis(paths: Sequence[str],
         key = (f.pass_id, f.path, f.context, f.message)
         f.ordinal = occurrence.get(key, 0)
         occurrence[key] = f.ordinal + 1
+    if report is not None:
+        report["timings"] = timings
     baseline = Baseline(baseline_path or default_baseline_path())
     if update_baseline:
-        baseline.write(findings,
-                       scanned_paths={c.path for c in ctxs})
+        baseline.write(findings, scanned_paths=scanned_rel)
         return [], findings
+    if prune_stale and pass_ids is None:
+        stale = baseline.prune(findings, scanned_rel)
+        if report is not None:
+            report["stale_pruned"] = stale
     unsuppressed = [f for f in findings if not baseline.suppresses(f)]
     return unsuppressed, findings

@@ -8,15 +8,19 @@ from typing import List
 def load_passes() -> List:
     from ray_tpu.devtools.analysis.passes import (
         async_blocking,
+        blocking_under_lock,
         bounded_queue,
         deadline_discipline,
         durable_write,
         lock_discipline,
+        lock_order,
         ref_leak,
         retry_discipline,
         rpc_surface,
         silent_exception,
+        wire_shape,
     )
     return [lock_discipline, async_blocking, rpc_surface,
             silent_exception, ref_leak, retry_discipline,
-            bounded_queue, deadline_discipline, durable_write]
+            bounded_queue, deadline_discipline, durable_write,
+            lock_order, blocking_under_lock, wire_shape]

@@ -31,6 +31,14 @@ Runtime introspection hooks pair with this: ``RpcServer.
 registered_methods()`` (and ``GcsServer.rpc_methods()``) expose the
 live table, and tests/test_static_analysis.py cross-checks the static
 scan against a real server's registrations.
+
+Since graftcheck v2 this is a phase-2 pass over the linked summary
+cache (``check_graph``): registrations and call sites are collected
+once per file by ``callgraph.summarize_file`` (cached on mtime/size),
+so in ``--changed`` mode the cross-check still sees the WHOLE
+program's surface, not just the edited files. ``_scan_file`` remains
+the single-file scanner (the runtime-introspection test uses it
+directly).
 """
 
 from __future__ import annotations
@@ -92,18 +100,17 @@ def _scan_file(ctx: FileContext
     return registrations, calls
 
 
-def check_project(ctxs: List[FileContext]) -> List[Finding]:
-    registered: Dict[str, List[Tuple[FileContext, int, bool]]] = {}
-    called: Dict[str, List[Tuple[FileContext, int]]] = {}
-    for ctx in ctxs:
-        regs, calls = _scan_file(ctx)
-        for name, sites in regs.items():
-            for line, external in sites:
-                registered.setdefault(name, []).append(
-                    (ctx, line, external))
-        for name, lines in calls.items():
-            for line in lines:
-                called.setdefault(name, []).append((ctx, line))
+def check_graph(graph) -> List[Finding]:
+    # (path, line, scope[, external]) sites from the linked summaries
+    registered: Dict[str, List[Tuple[str, int, str, bool]]] = {}
+    called: Dict[str, List[Tuple[str, int, str]]] = {}
+    for path, s in graph.summaries.items():
+        for name, line, external, _target, scope in s.get("rpc_regs",
+                                                          []):
+            registered.setdefault(name, []).append(
+                (path, line, scope, external))
+        for name, line, scope in s.get("rpc_calls", []):
+            called.setdefault(name, []).append((path, line, scope))
 
     findings: List[Finding] = []
     if not registered:
@@ -111,24 +118,22 @@ def check_project(ctxs: List[FileContext]) -> List[Finding]:
         # cross-check would flag every call site; stay silent instead
         # of lying.
         return findings
-    for name, sites in sorted(called.items()):
+    for name, csites in sorted(called.items()):
         if name in registered:
             continue
-        for ctx, line in sites:
+        for path, line, scope in csites:
             findings.append(Finding(
-                PASS_ID, ctx.path, line,
-                ctx.scope_of_line(line),
+                PASS_ID, path, line, scope,
                 f"client calls RPC method {name!r} but no server "
                 f"registers it"))
-    for name, sites in sorted(registered.items()):
+    for name, rsites in sorted(registered.items()):
         if name in called:
             continue
-        for ctx, line, external in sites:
+        for path, line, scope, external in rsites:
             if external:
                 continue
             findings.append(Finding(
-                PASS_ID, ctx.path, line,
-                ctx.scope_of_line(line),
+                PASS_ID, path, line, scope,
                 f"handler {name!r} is registered but never called "
                 "from any scanned client site (renamed caller? mark "
                 "`# rpc: external` if invoked from outside)"))

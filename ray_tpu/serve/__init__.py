@@ -365,6 +365,10 @@ def start(http: bool = True, proxy_location: str = "worker"):
                 actor = ray_tpu.remote(ProxyActor).options(
                     scheduling_strategy=NodeAffinitySchedulingStrategy(
                         node_id=head)).remote()
+                # blocking-ok: one-time proxy bring-up; the lock is
+                # what makes "exactly one worker proxy" true, and a
+                # second serve.start() racing it must wait for
+                # readiness, not spawn a twin
                 ray_tpu.get(actor.ping.remote(), timeout=60)
                 _worker_proxy = actor
                 controller.register_proxy(actor)

@@ -285,6 +285,11 @@ class ServeController:
             with self._lock:
                 infos = list(self._deployments.values())
             for info in infos:
+                # blocking-ok: _reconcile_lock exists to serialize
+                # exactly this pass (liveness probes, replica spawns)
+                # against deploy/delete; only those paths contend, and
+                # they must observe a finished reconcile, not overlap
+                # one. The hot path (router/handles) never takes it.
                 self._reconcile_deployment(info)
 
     def _reconcile_deployment(self, info: DeploymentInfo) -> None:

@@ -145,7 +145,14 @@ class ReplicaSet:
 
     def __init__(self, deployment_name: str):
         self.deployment_name = deployment_name
+        # The controller mutates replica sets while holding its own
+        # locks (reconcile -> state -> this set); nothing under _lock
+        # ever calls back into the controller (enforced by
+        # graftcheck's lock-order pass):
+        # lock-order: ServeController._reconcile_lock -> ServeController._lock -> _lock
         self._lock = threading.Lock()
+        # both CVs share _lock — waiting on either releases the same
+        # mutex, so they can never form a second lock-graph node
         self._slot_free = threading.Condition(self._lock)
         self._dispatch_cv = threading.Condition(self._lock)
         # per-replica in-flight cap (None = uncapped): the reference's

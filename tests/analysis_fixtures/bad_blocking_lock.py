@@ -1,0 +1,42 @@
+"""blocking-under-lock fixture. Flagged: a direct ``time.sleep``
+under the lock, a synchronous RPC round trip under the lock, and a
+transitive reach into a subprocess spawn through a helper. The good
+twins — blocking work after the lock releases, and an annotated
+deliberate stall — must NOT fire."""
+
+import subprocess
+import threading
+import time
+
+
+class Gate:
+    def __init__(self):
+        self._gate_lock = threading.Lock()
+        self.value = 0
+
+    def bad_sleep(self):
+        with self._gate_lock:
+            time.sleep(0.01)           # VIOLATION: sleep under lock
+
+    def bad_rpc(self, client):
+        with self._gate_lock:
+            # VIOLATION: wire round trip under lock
+            return client.call("fetch_state", timeout=1.0)
+
+    def bad_transitive(self):
+        with self._gate_lock:
+            return self._spawn()       # VIOLATION: reaches subprocess
+
+    def _spawn(self):
+        return subprocess.run(["true"], check=False)
+
+    def good_outside(self):
+        with self._gate_lock:
+            snapshot = self.value
+        time.sleep(0.01)               # fine: lock already released
+        return snapshot
+
+    def good_annotated(self):
+        with self._gate_lock:
+            # blocking-ok: fixture: documented single-writer stall
+            time.sleep(0.01)

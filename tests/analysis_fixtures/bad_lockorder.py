@@ -1,0 +1,61 @@
+"""lock-order fixture. Three cases:
+
+- ``BadNest.bad``: a transitive inversion against the declared
+  ``# lock-order: _a_lock -> _b_lock`` (takes ``_b_lock`` then calls a
+  helper that grabs ``_a_lock``) — exactly one inversion finding.
+- ``CycleRing``: two methods nesting ``_x_lock``/``_y_lock`` in
+  opposite orders with NO declaration — caught purely by cycle
+  detection, exactly one cycle finding.
+- ``BadNest.good`` / ``GoodLeaf``: correct nestings that must NOT
+  fire (the good twins).
+"""
+
+import threading
+
+
+class BadNest:
+    # lock-order: _a_lock -> _b_lock
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def good(self):
+        with self._a_lock:
+            with self._b_lock:
+                return True
+
+    def bad(self):
+        with self._b_lock:
+            return self._grab_a()      # INVERSION: _a under _b
+
+    def _grab_a(self):
+        with self._a_lock:
+            return True
+
+
+class CycleRing:
+    def __init__(self):
+        self._x_lock = threading.Lock()
+        self._y_lock = threading.Lock()
+
+    def one(self):
+        with self._x_lock:
+            with self._y_lock:
+                return 1
+
+    def two(self):
+        with self._y_lock:
+            with self._x_lock:         # CYCLE with ``one``
+                return 2
+
+
+class GoodLeaf:
+    # lock-order: _m_lock -> _n_lock
+    def __init__(self):
+        self._m_lock = threading.Lock()
+        self._n_lock = threading.Lock()
+
+    def fine(self):
+        with self._m_lock:
+            with self._n_lock:
+                return True

@@ -1,0 +1,41 @@
+"""wire-shape fixture. The file carries its own ``_FASTFRAME_SAFE``
+literal so it is self-contained. Flagged: a tuple-only isinstance
+gate on a fastframe handler's parameter, a ``type(...) is tuple`` gate
+on another tainted parameter, and a transitive gate in a helper the
+tainted value flows into. The good twins — a ``(tuple, list)`` gate,
+a gate in a handler whose method is NOT fastframe-safe, and an
+annotated gate — must NOT fire."""
+
+_FASTFRAME_SAFE = frozenset(("submit", "task_done"))
+
+
+def wire(server):
+    server.register("submit", handle_submit)        # rpc: external
+    server.register("plain_blob", handle_plain)     # rpc: external
+
+
+def handle_submit(ctx, spec, flags=None):
+    if isinstance(spec, tuple):             # VIOLATION: list rejected
+        spec = list(spec)
+    if isinstance(spec, (tuple, list)):     # good twin: normalized
+        body = spec
+    else:
+        body = [spec]
+    if type(flags) is tuple:                # VIOLATION: type-is gate
+        flags = list(flags)
+    # wire-shape-ok: fixture: annotated gate (proven pickled channel)
+    if isinstance(spec, tuple):
+        pass
+    return _forward(body)
+
+
+def _forward(payload):
+    if isinstance(payload, tuple):          # VIOLATION: via taint flow
+        return tuple(payload)
+    return payload
+
+
+def handle_plain(ctx, spec):
+    if isinstance(spec, tuple):             # fine: never rides RTF1
+        return spec
+    return None

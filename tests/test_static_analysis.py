@@ -453,3 +453,324 @@ def test_registered_methods_hook():
         assert server.registered_methods() == ("alpha", "beta")
     finally:
         server.shutdown()
+
+
+# -- graftcheck v2: whole-program passes ------------------------------------
+
+
+def test_twelve_passes_registered():
+    from ray_tpu.devtools.analysis.passes import load_passes
+    ids = [p.PASS_ID for p in load_passes()]
+    assert len(ids) == 12
+    for new in ("lock-order", "blocking-under-lock", "wire-shape"):
+        assert new in ids
+
+
+def test_lock_order_fixture():
+    """One declared-order inversion (transitive, via the helper call)
+    and one undeclared cycle; the good twins stay quiet."""
+    unsuppressed, _ = _run([_fixture("bad_lockorder.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "lock-order"]
+    assert len(hits) == 2
+    inversions = [h for h in hits if "inversion" in h.message]
+    cycles = [h for h in hits if "cycle" in h.message]
+    assert len(inversions) == 1 and len(cycles) == 1
+    assert inversions[0].context == "BadNest.bad"
+    assert "_a_lock" in inversions[0].message
+    assert "BadNest.bad -> BadNest._grab_a" in inversions[0].message
+    assert cycles[0].context == "CycleRing.one"
+    assert "_x_lock" in cycles[0].message
+
+
+def test_blocking_under_lock_fixture():
+    """Direct sleep, direct RPC, and a transitive subprocess reach are
+    flagged; post-release blocking and the annotated stall are not."""
+    unsuppressed, _ = _run([_fixture("bad_blocking_lock.py")])
+    hits = [f for f in unsuppressed
+            if f.pass_id == "blocking-under-lock"]
+    assert len(hits) == 3
+    by_ctx = {h.context: h.message for h in hits}
+    assert set(by_ctx) == {"Gate.bad_sleep", "Gate.bad_rpc",
+                           "Gate.bad_transitive"}
+    assert "time.sleep" in by_ctx["Gate.bad_sleep"]
+    assert "'fetch_state'" in by_ctx["Gate.bad_rpc"]
+    assert "subprocess.run" in by_ctx["Gate.bad_transitive"]
+    assert "Gate.bad_transitive -> Gate._spawn" \
+        in by_ctx["Gate.bad_transitive"]
+
+
+def test_wire_shape_fixture():
+    """Tuple-only gates on fastframe-tainted values are flagged — the
+    handler's own param, a type(...)-is gate, and a helper the value
+    flows into; (tuple, list) gates, non-fastframe handlers, and the
+    annotated gate are not."""
+    unsuppressed, _ = _run([_fixture("bad_wire_shape.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "wire-shape"]
+    assert len(hits) == 3
+    contexts = sorted(h.context for h in hits)
+    assert contexts == ["_forward", "handle_submit", "handle_submit"]
+    messages = " | ".join(h.message for h in hits)
+    assert "'submit'" in messages            # traced wire method
+    assert "type(...) is tuple" in messages
+    assert all("handle_plain" != h.context for h in hits)
+
+
+def test_lock_order_catches_inverted_raylet_flush(tmp_path):
+    """The acceptance scenario: take a scratch copy of the live
+    raylet, delete the machine-readable ordering declaration, and
+    invert the `_flush_pushes` acquisition — the cycle against the
+    surviving `_push_order_lock -> _push_lock` paths is caught with
+    no declaration in sight. With the declaration retained the same
+    edit is reported as an inversion."""
+    src = open(os.path.join(ROOT, "ray_tpu", "_private",
+                            "raylet_server.py")).read()
+    decl = ("# lock-order: _push_order_lock -> _push_lock -> "
+            "ConnectionContext._send_lock")
+    assert decl in src
+    old = ("    def _flush_pushes(self) -> None:\n"
+           "        with self._push_order_lock:\n"
+           "            self._flush_pushes_locked()\n")
+    assert old in src
+    inverted = src.replace(old, (
+        "    def _flush_pushes(self) -> None:\n"
+        "        with self._push_lock:\n"
+        "            with self._push_order_lock:\n"
+        "                self._flush_pushes_locked()\n"))
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    scratch = priv / "raylet_server.py"
+
+    # declaration deleted: cycle detection alone must catch it
+    scratch.write_text(inverted.replace(decl, "#"))
+    unsuppressed, _ = _run([str(scratch)], root=str(tmp_path))
+    hits = [f for f in unsuppressed if f.pass_id == "lock-order"]
+    assert hits, "inverted flush not caught without declaration"
+    assert any("cycle" in h.message and "_push_order_lock" in h.message
+               for h in hits)
+
+    # declaration retained: reported as an inversion against it
+    scratch.write_text(inverted)
+    unsuppressed, _ = _run([str(scratch)], root=str(tmp_path))
+    hits = [f for f in unsuppressed if f.pass_id == "lock-order"]
+    assert any("inversion" in h.message
+               and "_push_order_lock" in h.message for h in hits)
+
+
+def test_whole_program_cache_invalidation(tmp_path):
+    """Editing file A must invalidate a phase-2 finding whose evidence
+    spans A and B even when B's summary is a cache hit: phase 2 always
+    relinks the freshest summaries."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    handlers = priv / "handlers.py"
+    reg = priv / "reg.py"
+    handlers.write_text(
+        "def handle_submit(ctx, spec):\n"
+        "    if isinstance(spec, tuple):\n"
+        "        return spec\n"
+        "    return None\n")
+    reg_src = (
+        '_FASTFRAME_SAFE = frozenset(("submit",))\n'
+        "def wire(server):\n"
+        '    server.register("submit", handle_submit)  # rpc: external\n')
+    reg.write_text(reg_src)
+
+    unsuppressed, _ = _run([str(priv)], root=str(tmp_path),
+                           use_cache=True)
+    hits = [f for f in unsuppressed if f.pass_id == "wire-shape"]
+    assert len(hits) == 1 and "handlers.py" in hits[0].path
+
+    # edit A (the registration side) so the method is no longer
+    # fastframe-safe; B is untouched and its summary stays cache-hit
+    b_stat = os.stat(handlers)
+    reg.write_text(reg_src.replace('frozenset(("submit",))',
+                                   'frozenset(("other",))'))
+    unsuppressed, _ = _run([str(priv)], root=str(tmp_path),
+                           use_cache=True)
+    assert [f for f in unsuppressed if f.pass_id == "wire-shape"] == []
+    cache = json.load(open(tmp_path / ".rtpu_analysis_cache.json"))
+    entry = cache["files"][str(handlers)]
+    assert entry["stat"] == [b_stat.st_mtime, b_stat.st_size]
+
+    # and back: the finding returns, B still cache-hit
+    reg.write_text(reg_src)
+    unsuppressed, _ = _run([str(priv)], root=str(tmp_path),
+                           use_cache=True)
+    hits = [f for f in unsuppressed if f.pass_id == "wire-shape"]
+    assert len(hits) == 1
+
+
+def test_git_changed_file_discovery(tmp_path):
+    """--changed collects staged, unstaged, and untracked .py files —
+    including files inside a brand-new untracked DIRECTORY, which
+    plain `git status` collapses to one `dir/` entry — and reports
+    deletions separately (non-Python files excluded), all without
+    needing any commit."""
+    import subprocess as sp
+
+    from ray_tpu.devtools.analysis.__main__ import _git_changed_files
+
+    sp.run(["git", "init", "-q"], cwd=tmp_path, check=True)
+    (tmp_path / "a.py").write_text("x = 1\n")
+    (tmp_path / "b.txt").write_text("not python\n")
+    sub = tmp_path / "newpkg"
+    sub.mkdir()
+    (sub / "mod.py").write_text("z = 3\n")
+    existing, deleted = _git_changed_files(str(tmp_path))
+    assert existing == [str(tmp_path / "a.py"), str(sub / "mod.py")]
+    assert deleted == []
+    sp.run(["git", "add", "a.py"], cwd=tmp_path, check=True)
+    (tmp_path / "c.py").write_text("y = 2\n")
+    existing, _deleted = _git_changed_files(str(tmp_path))
+    assert str(tmp_path / "c.py") in existing
+    # a committed-then-deleted file lands in the deleted bucket
+    env = dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+    sp.run(["git", "commit", "-qm", "x"], cwd=tmp_path, check=True,
+           env=env)
+    (tmp_path / "a.py").unlink()
+    existing, deleted = _git_changed_files(str(tmp_path))
+    assert str(tmp_path / "a.py") not in existing
+    assert deleted == [str(tmp_path / "a.py")]
+
+
+def test_prune_never_judges_link_only_files(tmp_path):
+    """A --changed-style run (file A scanned, file B link-only) must
+    not prune B's per-file suppression: B surfaces only its phase-2
+    findings in that run, and judging its baseline on that partial
+    view would delete a valid entry and break the next full run."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    a = priv / "a.py"
+    b = priv / "b.py"
+    a.write_text("x = 1\n")
+    b.write_text("def f(fn):\n"
+                 "    try:\n"
+                 "        return fn()\n"
+                 "    except Exception:\n"
+                 "        pass\n")
+    baseline = str(tmp_path / "baseline.json")
+    _run([str(priv)], root=str(tmp_path), baseline_path=baseline,
+         update_baseline=True)
+    assert len(json.load(open(baseline))["findings"]) == 1
+
+    report = {}
+    unsuppressed, _ = _run([str(a)], root=str(tmp_path),
+                           baseline_path=baseline,
+                           link_paths=[str(priv)],
+                           prune_stale=True, report=report)
+    assert unsuppressed == []
+    assert report["stale_pruned"] == []
+    assert len(json.load(open(baseline))["findings"]) == 1
+    # and the full-tree run is still clean afterwards
+    unsuppressed, _ = _run([str(priv)], root=str(tmp_path),
+                           baseline_path=baseline)
+    assert unsuppressed == []
+
+
+def test_wire_shape_taint_killed_by_overwrite(tmp_path):
+    """An unconditional overwrite after a conditional taint must kill
+    the taint in source order: a gate on the overwritten value is not
+    a wire-shape finding (the flow map is a forward pass, not a
+    breadth-first walk that would resurrect dead taint)."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    mod = priv / "mod.py"
+    mod.write_text(
+        '_FASTFRAME_SAFE = frozenset(("submit",))\n'
+        "def wire(server):\n"
+        '    server.register("submit", handle)  # rpc: external\n'
+        "def compute():\n"
+        "    return ()\n"
+        "def handle(ctx, spec):\n"
+        "    if ctx:\n"
+        "        y = spec\n"
+        "    y = compute()\n"
+        "    if isinstance(y, tuple):\n"
+        "        return y\n"
+        "    return None\n")
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path))
+    assert [f for f in unsuppressed if f.pass_id == "wire-shape"] == []
+
+
+def test_link_paths_feed_whole_program_passes(tmp_path):
+    """The --changed contract at the run_analysis level: scanning only
+    file B with A in the link set still produces the cross-file
+    finding, while A's own per-file findings are not reported."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    handlers = priv / "handlers.py"
+    reg = priv / "reg.py"
+    handlers.write_text(
+        "def handle_submit(ctx, spec):\n"
+        "    if isinstance(spec, tuple):\n"
+        "        return spec\n"
+        "    return None\n")
+    reg.write_text(
+        '_FASTFRAME_SAFE = frozenset(("submit",))\n'
+        "import time\n"
+        "def wire(server):\n"
+        '    server.register("submit", handle_submit)  # rpc: external\n')
+    unsuppressed, _ = _run([str(handlers)], root=str(tmp_path),
+                           link_paths=[str(priv)])
+    hits = [f for f in unsuppressed if f.pass_id == "wire-shape"]
+    assert len(hits) == 1 and "handlers.py" in hits[0].path
+
+
+def test_timings_report():
+    report = {}
+    _run([_fixture("clean.py")], report=report)
+    t = report["timings"]
+    assert "parse+summarize" in t
+    for pass_id in ("lock-order", "blocking-under-lock", "wire-shape",
+                    "rpc-surface", "lock-discipline"):
+        assert pass_id in t and t[pass_id] >= 0.0
+
+
+def test_stale_baseline_pruning(tmp_path):
+    """A baselined finding that no longer fires is reported and
+    removed; entries for files the run never analyzed survive."""
+    baseline = str(tmp_path / "baseline.json")
+    mod = tmp_path / "mod.py"
+    mod.write_text("def f(fn):\n"
+                   "    try:\n"
+                   "        return fn()\n"
+                   "    except Exception:\n"
+                   "        pass\n")
+    _run([str(mod)], root=str(tmp_path), baseline_path=baseline,
+         update_baseline=True)
+    _run([_fixture("bad_silent.py")], baseline_path=baseline,
+         update_baseline=True)
+    assert len(json.load(open(baseline))["findings"]) == 2
+
+    # fix mod.py: its accepted finding no longer fires
+    mod.write_text("def f(fn):\n    return fn()\n")
+    report = {}
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path),
+                           baseline_path=baseline, prune_stale=True,
+                           report=report)
+    assert unsuppressed == []
+    stale = report["stale_pruned"]
+    assert len(stale) == 1 and stale[0]["path"] == "mod.py"
+    kept = json.load(open(baseline))["findings"]
+    assert len(kept) == 1                      # unscanned entry kept
+    assert "bad_silent" in kept[0]["path"]
+    # the fixture's suppression still works after the prune
+    unsuppressed, _ = _run([_fixture("bad_silent.py")],
+                           baseline_path=baseline)
+    assert unsuppressed == []
+
+
+def test_cached_full_suite_stays_fast():
+    """CI-hygiene bound: a warm-cache re-run of all twelve passes over
+    the whole tree must stay comfortably inside the tier-1 budget
+    (< 5s with generous headroom; the observed cost is ~0.3s)."""
+    import time as _time
+
+    tree = os.path.join(ROOT, "ray_tpu")
+    _run([tree], use_cache=True)               # warm the cache
+    t0 = _time.perf_counter()
+    unsuppressed, _ = _run([tree], use_cache=True)
+    elapsed = _time.perf_counter() - t0
+    assert unsuppressed == []
+    assert elapsed < 5.0, f"cached graftcheck re-run took {elapsed:.2f}s"
