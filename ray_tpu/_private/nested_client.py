@@ -366,7 +366,7 @@ class NestedClient:
 
 
 _nested: Optional[NestedClient] = None
-_nested_lock = threading.Lock()
+_nested_lock = threading.Lock()  # blocking-ok: singleton dial — the one nested-client connect runs under the lock BY DESIGN
 
 
 def get_nested_client() -> Optional[NestedClient]:

@@ -81,7 +81,7 @@ class PeerClients:
 
     def __init__(self):
         self._clients: Dict[Tuple[str, int], RpcClient] = {}
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # blocking-ok: dial-once cache — RpcClient() handshakes under the lock BY DESIGN so two pulls never double-dial a peer
 
     def get(self, addr: Tuple[str, int]) -> RpcClient:
         addr = tuple(addr)

@@ -148,7 +148,7 @@ class RayletServer:
         # its completions on the wire. Never reversed (graftcheck's
         # lock-order pass enforces the declaration below):
         # lock-order: _push_order_lock -> _push_lock -> ConnectionContext._send_lock
-        self._push_order_lock = threading.Lock()
+        self._push_order_lock = threading.Lock()  # blocking-ok: flush-ahead ordering — the send MUST complete under this lock or a commit can overtake its completions on the wire
         self._push_armed = threading.Event()
         self._last_push_ts = 0.0  # guarded-by: _push_lock
         if self._push_coalesce_s > 0:

@@ -407,7 +407,7 @@ class ConnectionContext:
 
     def __init__(self, sock: socket.socket, peer, component: str = ""):
         self._sock = sock
-        self._send_lock = threading.Lock()
+        self._send_lock = threading.Lock()  # blocking-ok: held across sendall BY DESIGN — frame atomicity on a shared socket
         self.peer = peer
         self.component = component
         self.alive = True
@@ -677,7 +677,7 @@ class RpcClient:
         if len(hello) > 1 and isinstance(hello[1], dict):
             self.fastframe = "fastframe" in (hello[1].get("feats") or ())
         self._sock.settimeout(None)
-        self._send_lock = threading.Lock()
+        self._send_lock = threading.Lock()  # blocking-ok: held across sendall BY DESIGN — frame atomicity on a shared socket
         self._pending: Dict[int, queue.Queue] = {}
         self._pending_lock = threading.Lock()
         self._req_counter = 0
@@ -923,7 +923,7 @@ class RetryingRpcClient:
         self._reconnect_window = reconnect_window
         self._auto_reconnect = auto_reconnect
         self._rng = random.Random(seed)
-        self._lock = threading.RLock()
+        self._lock = threading.RLock()  # blocking-ok: reconnect lock — the handshake I/O runs under it BY DESIGN so concurrent calls queue behind one dial instead of racing it
         self._inner: Optional[RpcClient] = None  # guarded-by: _lock
         # Background-reconnector handoff state. _bg_active is the
         # LOGICAL liveness of the reconnector (flipped under _lock, so
@@ -1175,3 +1175,16 @@ def wait_for_server(address: Tuple[str, int], timeout: float = 10.0) -> None:
             raise TimeoutError(f"no rpc server at {address}: {last}")
         time.sleep(min(delay, remaining))
         delay = min(delay * 2, 0.5)
+
+
+# graftsan blocking probes: with RTPU_SANITIZE=1 the frame
+# primitives report when called with an instrumented, non-escaped
+# lock held (see devtools/sanitizer). `_send_frame` legitimately
+# runs under the per-connection `_send_lock` — that lock carries a
+# `# blocking-ok:` escape on its definition, so the probe covers
+# every OTHER lock accidentally held across a socket write.
+if os.environ.get("RTPU_SANITIZE") == "1":
+    from ray_tpu.devtools.sanitizer import wrap_blocking as _wrap_blocking
+
+    _send_frame = _wrap_blocking(_send_frame, "socket", "rpc._send_frame")
+    _recv_frame = _wrap_blocking(_recv_frame, "socket", "rpc._recv_frame")

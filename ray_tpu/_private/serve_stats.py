@@ -18,8 +18,8 @@ import weakref
 
 _lock = threading.Lock()
 
-# cumulative counters  # guarded-by: _lock
-_counters = {
+# cumulative counters
+_counters = {  # guarded-by: _lock
     "requests": 0,        # requests accepted into a router
     "shed": 0,            # requests shed with BackpressureError
     "batches": 0,         # batched dispatches sent to replicas
@@ -31,8 +31,8 @@ _counters = {
 # not be kept alive by the metrics plane).
 _controllers: "weakref.WeakSet" = weakref.WeakSet()
 
-# RPS window state  # guarded-by: _lock
-_rps_prev = {"t": None, "n": 0}
+# RPS window state
+_rps_prev = {"t": None, "n": 0}  # guarded-by: _lock
 
 
 def incr(name: str, n: int = 1) -> None:

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization
 from ray_tpu._private.config import get_config
@@ -175,23 +175,33 @@ class TaskManager:
         """``results``: [(oid_bytes, kind, data, contained_ref_bytes)].
         ``error_blob``: serialized TaskError (app-level).
         ``system_error``: worker crash etc. — always retryable."""
-        # The retry decision runs under _lock; the resubmit callback
-        # runs AFTER it releases. _resubmit (Worker) takes _actor_lock,
+        # The retry decision runs under _lock; BOTH callbacks run
+        # AFTER it releases. _resubmit (Worker) takes _actor_lock,
         # and _actor_lock holders call back into this manager
         # (_resubmit -> _fail_task -> mark_failed_external), so calling
         # out while holding _lock nests the two locks in both orders —
         # the AB/BA deadlock the lock-order pass exists to catch.
+        # _store_result (Worker) is just as entangled: it fans out to
+        # NodeManagerGroup.on_object_available (takes that group's
+        # _lock) while the steal path holds the group lock and calls
+        # back into get_record here — graftsan caught that inversion
+        # actually executing under test load, through dynamic dispatch
+        # the static resolver can't follow.
+        stores: List[Tuple[ObjectID, Entry]] = []
         with self._lock:
             resubmit_spec = self._complete_locked(
-                task_id, results, error_blob, system_error)
+                task_id, results, error_blob, system_error, stores)
+        for oid, entry in stores:
+            self._store_result(oid, entry)
         if resubmit_spec is not None:
             self._resubmit(resubmit_spec)
 
     # lock-held: _lock
     def _complete_locked(self, task_id, results, error_blob,
-                         system_error):
+                         system_error, stores):
         """Terminal-state bookkeeping; returns the spec to resubmit
-        (caller invokes the callback outside the lock) or None."""
+        and appends result entries to ``stores`` (caller invokes both
+        callbacks outside the lock) or None."""
         rec = self._tasks.get(task_id)
         if rec is None:
             return None
@@ -207,7 +217,7 @@ class TaskManager:
                 entry = Entry(
                     kind_map[kind], data,
                     tuple(_contained_item(c) for c in contained))
-                self._store_result(ObjectID(oid_b), entry)
+                stores.append((ObjectID(oid_b), entry))
             return None
         # failure path
         if rec.cancelled:
@@ -221,7 +231,7 @@ class TaskManager:
                     f"task {rec.spec.repr_name()} was cancelled"
                 )).to_bytes()
             for oid in rec.spec.return_ids:
-                self._store_result(oid, Entry("err", blob))
+                stores.append((oid, Entry("err", blob)))
             return None
         if isinstance(system_error, OutOfMemoryError):
             # Memory-watchdog kill: its own retry budget
@@ -257,7 +267,7 @@ class TaskManager:
             blob = serialization.get_context().serialize(
                 system_error).to_bytes()
             for oid in rec.spec.return_ids:
-                self._store_result(oid, Entry("err", blob))
+                stores.append((oid, Entry("err", blob)))
             return None
         retryable = system_error is not None
         if error_blob is not None and rec.spec.retry_exceptions:
@@ -282,7 +292,7 @@ class TaskManager:
                     f"{type(system_error).__name__}: {system_error}")
             error_blob = serialization.get_context().serialize(err).to_bytes()
         for oid in rec.spec.return_ids:
-            self._store_result(oid, Entry("err", error_blob))
+            stores.append((oid, Entry("err", error_blob)))
 
     def mark_failed_external(self, task_id: TaskID) -> None:
         """Record an OUT-OF-BAND terminal failure — the caller stored

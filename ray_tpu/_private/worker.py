@@ -339,8 +339,8 @@ class Worker:
         self._pending_restore: Dict[ActorID, dict] = {}  # guarded-by: _actor_lock
         # gang -> gen -> {actor_id: saved-info}; partial generations
         # are discarded on gang abort/restart
-        self._gang_ckpt_stage: Dict[str, Dict[int, Dict[ActorID, dict]]] \
-            = {}  # guarded-by: _gang_lock
+        self._gang_ckpt_stage: Dict[  # guarded-by: _gang_lock
+            str, Dict[int, Dict[ActorID, dict]]] = {}
         self.num_ckpt_saved = 0       # committed generations (per actor)
         self.num_ckpt_restored = 0    # successful restores at creation
         self.num_ckpt_discarded = 0   # torn/uncommitted/partial drops
@@ -1712,6 +1712,9 @@ class Worker:
         # after this drain re-sets the wake event, so one pass is
         # enough — no retry loop.)
         with flush_lock:
+            # blocking-ok: per-actor flush lock exists to hold across
+            # the send — pop+ship must be atomic per actor or two
+            # flushers reorder seq N and N+1 on the wire
             self._drain_actor_queue(actor_id)
 
     def _drain_actor_queue(self, actor_id: ActorID) -> None:
@@ -2726,7 +2729,7 @@ class Worker:
 # global singleton
 
 _global_worker: Optional[Worker] = None
-_global_lock = threading.Lock()
+_global_lock = threading.Lock()  # blocking-ok: lifecycle lock — held across full init/shutdown (process spawns, joins, backoff sleeps) so concurrent init() blocks until the transition lands
 
 
 def init(**kwargs) -> Worker:

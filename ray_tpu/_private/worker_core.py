@@ -375,7 +375,7 @@ def try_worker_core() -> Optional[WorkerCore]:
 
 # Borrower-side peer-connection cache. Entries drop on connection death.
 _peers: Dict[Tuple[str, int], Any] = {}
-_peers_lock = threading.Lock()
+_peers_lock = threading.Lock()  # blocking-ok: dial-once cache — peer connect handshakes under the lock BY DESIGN so borrowers never double-dial
 
 
 def _peer(addr: Tuple[str, int]):

@@ -108,7 +108,22 @@ def main(argv=None) -> int:
                              "full summary cache")
     parser.add_argument("--timings", action="store_true",
                         help="print per-pass wall-clock timings")
+    parser.add_argument("--emit-contracts", action="store_true",
+                        help="write the graftsan contract manifest "
+                             "(devtools/analysis/contracts.json) from "
+                             "the phase-1 summaries and exit")
     args = parser.parse_args(argv)
+
+    if args.emit_contracts:
+        from ray_tpu.devtools.analysis import contracts
+        manifest = contracts.emit_contracts(args.paths or None)
+        out = contracts.write_contracts(manifest)
+        print(f"contracts written: {len(manifest['lock_sites'])} lock "
+              f"site(s), {len(manifest['orders'])} order "
+              f"declaration(s), "
+              f"{sum(len(c) for g in manifest['guarded'].values() for c in g.values())} "
+              f"guarded field(s) -> {out}")
+        return 0
 
     if args.list_passes:
         for p in load_passes():

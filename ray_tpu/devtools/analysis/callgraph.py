@@ -54,7 +54,7 @@ from ray_tpu.devtools.analysis.core import (FileContext, attr_tail,
 
 # Bump to invalidate every cached summary (core folds this into the
 # cache version tag alongside the per-pass versions).
-SUMMARY_VERSION = 1
+SUMMARY_VERSION = 2
 
 # A with-item / lock-arg is considered lock-like when its defining
 # class marks it as a lock, or (fallback for files whose __init__ was
@@ -67,6 +67,15 @@ _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
 _LOCK_ORDER_RE = re.compile(r"lock-order:\s*([\w.]+(?:\s*->\s*[\w.]+)*)")
 _HELD_RE = re.compile(r"lock-held:\s*(\w+)")
 _EXTERNAL_RE = re.compile(r"rpc:\s*external")
+_GUARDED_RE = re.compile(r"guarded-by:\s*(\w+)")
+_BLOCKING_OK_RE = re.compile(r"blocking-ok:\s*(.*)")
+# Field the annotation binds to: `self.<field> = ...` inside a class,
+# `<name> = ...` at column 0 for module-level state (same shapes the
+# lock-discipline pass recognizes).
+_SELF_FIELD_RE = re.compile(r"self\.(\w+)\s*[:=\[]")
+_MODULE_FIELD_RE = re.compile(r"^(\w+)\s*[:=\[]")
+
+_CHAOS_METHODS = {"fire", "fire_arg"}
 
 _BLOCKING_OK_MARK = "blocking-ok:"
 _WIRE_OK_MARK = "wire-shape-ok:"
@@ -122,6 +131,10 @@ class _FnSummarizer(ast.NodeVisitor):
         self.held: List[list] = list(held0)
         self.events: List[list] = []
         self.gates: List[list] = []
+        # `# blocking-ok:` annotated site line spans — the sanitizer's
+        # runtime probes skip a blocking call whose caller frame lands
+        # inside one of these (graftsan manifest `blocking_escapes`).
+        self.escapes: List[list] = []
 
     # -- helpers -------------------------------------------------------
 
@@ -232,9 +245,12 @@ class _FnSummarizer(ast.NodeVisitor):
         blocked = self._classify_blocking(node, fn, tail, recv)
         if blocked is not None:
             kind, desc = blocked
-            self._event("block",
-                        [kind, desc, self._ok(node, _BLOCKING_OK_MARK)],
-                        node)
+            ok = self._ok(node, _BLOCKING_OK_MARK)
+            if ok:
+                self.escapes.append(
+                    [node.lineno,
+                     getattr(node, "end_lineno", node.lineno)])
+            self._event("block", [kind, desc, ok], node)
         if tail is not None and blocked is None:
             lock_args: Dict[str, list] = {}
             derived: Dict[str, List[str]] = {}
@@ -251,10 +267,15 @@ class _FnSummarizer(ast.NodeVisitor):
                 spec = _lockspec(kw.value)
                 if spec is not None:
                     lock_args["k:" + kw.arg] = spec
+            ok = self._ok(node, _BLOCKING_OK_MARK)
+            if ok:
+                self.escapes.append(
+                    [node.lineno,
+                     getattr(node, "end_lineno", node.lineno)])
             self._event("call",
                         [tail, recv or "",
                          {"lock_args": lock_args, "args": derived,
-                          "ok": self._ok(node, _BLOCKING_OK_MARK)}],
+                          "ok": ok}],
                         node)
         # type(x) is tuple gates live in Compare, handled below; here
         # catch isinstance(...)
@@ -427,9 +448,23 @@ def summarize_file(ctx: FileContext) -> dict:
     classes: Dict[str, dict] = {}
     functions: Dict[str, dict] = {}
 
+    def _def_escape(line_no: int) -> Optional[str]:
+        """`# blocking-ok: <why>` on a lock DEFINITION line escapes the
+        lock itself at runtime: graftsan's blocking probes ignore it
+        (e.g. ``_send_lock`` is held across ``sendall`` by design)."""
+        comment = ctx.comments.get(line_no)
+        if comment:
+            m = _BLOCKING_OK_RE.search(comment)
+            if m:
+                return m.group(1).strip() or "annotated"
+        return None
+
     # lock definitions + aliases (Condition(self._x) aliases _x)
-    def scan_lock_defs(cls: ast.ClassDef) -> Tuple[list, dict]:
+    def scan_lock_defs(cls: ast.ClassDef
+                       ) -> Tuple[list, dict, dict, dict]:
         locks, aliases = [], {}
+        lock_lines: Dict[str, int] = {}
+        lock_escapes: Dict[str, str] = {}
         for node in ast.walk(cls):
             if not isinstance(node, ast.Assign) or \
                     not isinstance(node.value, ast.Call):
@@ -442,13 +477,19 @@ def summarize_file(ctx: FileContext) -> dict:
                         and isinstance(t.value, ast.Name)
                         and t.value.id == "self"):
                     locks.append(t.attr)
+                    lock_lines[t.attr] = node.lineno
+                    why = _def_escape(node.lineno)
+                    if why is not None:
+                        lock_escapes[t.attr] = why
                     if ctor == "Condition" and node.value.args:
                         spec = _lockspec(node.value.args[0])
                         if spec is not None and spec[0] == "self":
                             aliases[t.attr] = spec[1]
-        return locks, aliases
+        return locks, aliases, lock_lines, lock_escapes
 
     module_locks: List[str] = []
+    module_lock_lines: Dict[str, int] = {}
+    module_lock_escapes: Dict[str, str] = {}
     for node in ctx.tree.body:
         if isinstance(node, ast.Assign) and isinstance(node.value,
                                                        ast.Call):
@@ -456,6 +497,10 @@ def summarize_file(ctx: FileContext) -> dict:
                 for t in node.targets:
                     if isinstance(t, ast.Name):
                         module_locks.append(t.id)
+                        module_lock_lines[t.id] = node.lineno
+                        why = _def_escape(node.lineno)
+                        if why is not None:
+                            module_lock_escapes[t.id] = why
 
     # lock-order declarations: comment anywhere; owner class = the
     # class whose body encloses the comment line (None at module level)
@@ -466,21 +511,56 @@ def summarize_file(ctx: FileContext) -> dict:
             class_spans.append((node.lineno,
                                 getattr(node, "end_lineno", node.lineno),
                                 node.name))
-            locks, aliases = scan_lock_defs(node)
-            classes[node.name] = {"locks": locks, "aliases": aliases}
-    for line_no, comment in ctx.comments.items():
-        m = _LOCK_ORDER_RE.search(comment)
-        if not m:
-            continue
-        # owner = innermost (tightest) class span containing the line
+            locks, aliases, lock_lines, lock_escapes = \
+                scan_lock_defs(node)
+            classes[node.name] = {"locks": locks, "aliases": aliases,
+                                  "lock_lines": lock_lines,
+                                  "lock_escapes": lock_escapes}
+
+    def owner_class(line_no: int) -> Optional[str]:
+        # innermost (tightest) class span containing the line
         best = None
         for start, end, name in class_spans:
             if start <= line_no <= end and (
                     best is None or (end - start) < best[0]):
                 best = (end - start, name)
-        owner = best[1] if best else None
+        return best[1] if best else None
+
+    for line_no, comment in ctx.comments.items():
+        m = _LOCK_ORDER_RE.search(comment)
+        if not m:
+            continue
+        owner = owner_class(line_no)
         elements = [e.strip() for e in m.group(1).split("->")]
         lock_orders.append([line_no, owner, elements])
+
+    # `# guarded-by:` annotations — bound to the field assigned on the
+    # annotation's line (class scope: `self.<field>`, module scope:
+    # column-0 `<name> =`). Unbound annotations are kept so the
+    # sanitizer-coverage pass can flag them as orphaned.
+    guarded: Dict[str, dict] = {}       # owner ('' = module) -> fields
+    guarded_comments: List[list] = []   # [line, lock, field?, owner?]
+    for line_no, comment in sorted(ctx.comments.items()):
+        m = _GUARDED_RE.search(comment)
+        if not m:
+            continue
+        lock = m.group(1)
+        owner = owner_class(line_no)
+        src = ctx.lines[line_no - 1] if line_no - 1 < len(ctx.lines) \
+            else ""
+        field = None
+        if owner is not None:
+            fm = _SELF_FIELD_RE.search(src)
+            if fm:
+                field = fm.group(1)
+        else:
+            fm = _MODULE_FIELD_RE.match(src)
+            if fm:
+                field = fm.group(1)
+        guarded_comments.append([line_no, lock, field, owner])
+        if field is not None:
+            guarded.setdefault(owner or "", {})[field] = \
+                {"lock": lock, "line": line_no}
 
     # Scope lookup via one precomputed span table (summaries must
     # carry the same "Class.method" strings ctx.scope_of_line would
@@ -510,6 +590,33 @@ def summarize_file(ctx: FileContext) -> dict:
                     best is None or (end - start) < best[0]):
                 best = (end - start, dotted)
         return best[1] if best else "<module>"
+
+    # `# unbounded-ok:` annotated lines — carried into the contract
+    # manifest so reviewed unbounded-growth escapes stay visible to
+    # the sanitizer tooling alongside the blocking escapes.
+    unbounded_ok_sites: List[int] = sorted(
+        line for line, c in ctx.comments.items() if "unbounded-ok:" in c)
+
+    # chaos hook sites (`chaos.fire(component, point, ...)`) — the
+    # manifest records them so a sanitized chaos run can report which
+    # fault points the enforcement actually covered.
+    chaos_points: List[list] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in _CHAOS_METHODS):
+            continue
+        recv = attr_tail(fn.value)
+        if recv is None or "chaos" not in recv.lower():
+            continue
+        component = _literal_str(node.args[0]) if node.args else None
+        point = _literal_str(node.args[1]) if len(node.args) > 1 \
+            else None
+        if component is not None and point is not None:
+            chaos_points.append([node.lineno, fn.attr, component,
+                                 point])
 
     # RPC surface (phase-2 rpc-surface pass links these project-wide)
     rpc_regs: List[list] = []
@@ -554,6 +661,8 @@ def summarize_file(ctx: FileContext) -> dict:
                     fastframe = sorted({n for n in names if n})
 
     # functions
+    blocking_ok_sites: List[list] = []
+
     def walk_functions(body, cls: Optional[str], prefix: str) -> None:
         for node in body:
             if isinstance(node, ast.ClassDef):
@@ -580,6 +689,7 @@ def summarize_file(ctx: FileContext) -> dict:
                     "gates": s.gates,
                     "taint_flow": _collect_taint_flow(node),
                 }
+                blocking_ok_sites.extend(s.escapes)
                 walk_functions(node.body, cls, qual + ".")
 
     walk_functions(ctx.tree.body, None, "")
@@ -588,8 +698,15 @@ def summarize_file(ctx: FileContext) -> dict:
         "path": ctx.path,
         "classes": classes,
         "module_locks": module_locks,
+        "module_lock_lines": module_lock_lines,
+        "module_lock_escapes": module_lock_escapes,
         "functions": functions,
         "lock_orders": lock_orders,
+        "guarded": guarded,
+        "guarded_comments": guarded_comments,
+        "chaos_points": chaos_points,
+        "blocking_ok_sites": blocking_ok_sites,
+        "unbounded_ok_sites": unbounded_ok_sites,
         "rpc_regs": rpc_regs,
         "rpc_calls": rpc_calls,
         "fastframe_safe": fastframe,
@@ -710,6 +827,17 @@ class ProjectGraph:
 
     def _canonical(self, cls: str, name: str) -> str:
         return self.aliases.get(cls, {}).get(name, name)
+
+    def lock_node_known(self, node: Tuple[str, str]) -> bool:
+        """True when ``(owner, name)`` maps to a lock DEFINITION the
+        tree actually contains (a class attribute assignment or a
+        module-level lock) — the sanitizer-coverage pass's notion of
+        an instrumentable site."""
+        owner, name = node
+        if owner.startswith("mod:"):
+            return name in self.module_locks.get(owner[4:], ())
+        name = self._canonical(owner, name)
+        return owner in self.lock_defs.get(name, ())
 
     def resolve_lock(self, fi: FuncInfo, spec: Sequence
                      ) -> List[Tuple[str, str]]:

@@ -331,6 +331,18 @@ class FileCache:
                               "summary": summary}
         self.dirty = True
 
+    def prune_missing(self) -> List[str]:
+        """Drop (and return) entries whose file no longer exists. Runs
+        on EVERY analysis (a ``--changed`` scan included): a deleted
+        file's cached summary would otherwise sit in the cache forever
+        and — were it ever linked — fabricate call-graph edges from
+        code that is gone."""
+        dead = [p for p in self.data if not os.path.exists(p)]
+        for p in dead:
+            del self.data[p]
+            self.dirty = True
+        return dead
+
     def save(self) -> None:
         if not (self.path and self.dirty):
             return
@@ -408,6 +420,7 @@ def run_analysis(paths: Sequence[str],
         + [f"{p.PASS_ID}={getattr(p, 'VERSION', 0)}" for p in passes])
     cache = FileCache(os.path.join(root, CACHE_BASENAME) if use_cache
                       else "", version_tag)
+    cache.prune_missing()
 
     file_passes = [p for p in passes if hasattr(p, "check_file")]
     graph_passes = [p for p in passes if hasattr(p, "check_graph")]

@@ -17,10 +17,12 @@ def load_passes() -> List:
         ref_leak,
         retry_discipline,
         rpc_surface,
+        sanitizer_coverage,
         silent_exception,
         wire_shape,
     )
     return [lock_discipline, async_blocking, rpc_surface,
             silent_exception, ref_leak, retry_discipline,
             bounded_queue, deadline_discipline, durable_write,
-            lock_order, blocking_under_lock, wire_shape]
+            lock_order, blocking_under_lock, wire_shape,
+            sanitizer_coverage]

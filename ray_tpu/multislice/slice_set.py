@@ -26,6 +26,15 @@ Member actors must implement two methods (the trainer worker in
   group when a rank is given, structured no-op for ``None`` (every
   rank receives the call so per-gang call counts stay SPMD-symmetric
   for the checkpoint plane).
+
+Concurrency contract (graftsan audit): this driver-side object is
+deliberately lock-free — its fields are only touched from the driver
+thread that created it. The CONCURRENT coordinator state (sliceset
+records, gang->set mapping, DCN counters) lives in
+``_private/worker.py`` under ``Worker._sliceset_lock``, where every
+field carries its ``# guarded-by:`` annotation and graftsan enforces
+it at runtime. Mutating SliceSet fields from a callback thread is a
+bug; route such state through the worker coordinator instead.
 """
 
 from __future__ import annotations

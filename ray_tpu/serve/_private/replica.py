@@ -28,6 +28,15 @@ User exceptions are captured PER ITEM and shipped in the reply
 envelope; an envelope-level failure therefore always means the
 replica (or its transport) died, which is what makes the router's
 retry-once-then-typed-fail contract safe.
+
+Concurrency contract (graftsan audit): this module holds NO locks on
+purpose — every mutable field (`_items`, `_ongoing`, `_admission`,
+batcher state) is confined to the replica's asyncio event loop, so
+``# guarded-by:`` does not apply here. Cross-thread state for the
+serve plane lives in the router (``router.py``, guarded by
+``ReplicaSet._lock``) and the process-wide counters
+(``_private/serve_stats.py``, guarded by its module ``_lock``). Adding
+a thread to this module means adding a lock AND its annotations.
 """
 
 from __future__ import annotations

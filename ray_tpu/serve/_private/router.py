@@ -170,16 +170,16 @@ class ReplicaSet:
         # copies rely on the replica-side semaphore (see _rebuild)
         self._router_wait = True
         self._driver_side = True
-        self._replicas: List = []          # ActorHandle list
-        self._inflight: Dict[int, int] = {}  # id(handle) -> count
+        self._replicas: List = []  # ActorHandle list  # guarded-by: _lock
+        self._inflight: Dict[int, int] = {}  # id(handle) -> count  # guarded-by: _lock
         # depth each replica reported on its last batch reply, minus
         # our own charges at that moment: OTHER routers' load there
-        # (the piggybacked pow-2 signal)  # guarded-by: _lock
-        self._peer_load: Dict[int, int] = {}
+        # (the piggybacked pow-2 signal)
+        self._peer_load: Dict[int, int] = {}  # guarded-by: _lock
         # model multiplexing: sticky model_id -> replica key, so a
         # model's requests keep hitting the replica whose LRU already
         # holds it (reference: model-aware replica scheduling)
-        self._model_routes: Dict[str, int] = {}
+        self._model_routes: Dict[str, int] = {}  # guarded-by: _lock
         # batched-dispatch plane (driver-side only)
         # unbounded-ok: admission-bounded — assign() sheds beyond
         # max_queued_requests before appending, so depth never exceeds
@@ -189,8 +189,8 @@ class ReplicaSet:
         # unbounded-ok: bounded by outstanding dispatches, themselves
         # bounded by max_queued_requests / max_ongoing admission
         self._done: deque = deque()              # guarded-by: _lock
-        self._outstanding = 0        # dispatched, unresolved batches
-        self._waiters = 0            # begin() admission waiters
+        self._outstanding = 0  # dispatched, unresolved batches  # guarded-by: _lock
+        self._waiters = 0      # begin() admission waiters  # guarded-by: _lock
         self._flusher: Optional[threading.Thread] = None
         self._closed = False         # guarded-by: _lock
         self._rng = random.Random(0xF00D)

@@ -1,0 +1,63 @@
+"""sanitizer-coverage fixture. Four seeded rot cases plus good twins:
+
+- ``Orphaned``: a ``# guarded-by:`` on a prose comment line that binds
+  to no field — exactly one orphaned-annotation finding.
+- ``TypoLock``: a bound ``# guarded-by:`` naming a lock no class or
+  module defines — exactly one unknown-lock finding.
+- module ``# lock-order:`` whose second element names a ghost lock —
+  exactly one unresolvable-declaration finding.
+- ``TypoHeld._helper``: a ``# lock-held:`` naming a ghost lock —
+  exactly one dead-suppression finding.
+- ``GoodGuard``: correctly bound annotations over defined locks that
+  must NOT fire.
+"""
+
+import threading
+
+# lock-order: GoodGuard._g_lock -> GoodGuard._ghost_order_lock
+
+
+class Orphaned:
+    # The counters below are shared across worker threads.
+    # guarded-by: _o_lock
+    def __init__(self):
+        self._o_lock = threading.Lock()
+        self._n = 0
+
+    def bump(self):
+        with self._o_lock:
+            self._n += 1
+
+
+class TypoLock:
+    def __init__(self):
+        self._t_lock = threading.Lock()
+        self._m = 0  # guarded-by: _t_lok
+
+    def bump(self):
+        with self._t_lock:
+            self._m += 1
+
+
+class TypoHeld:
+    def __init__(self):
+        self._h_lock = threading.Lock()
+        self._k = 0
+
+    def bump(self):
+        with self._h_lock:
+            self._helper()
+
+    # lock-held: _h_lok
+    def _helper(self):
+        self._k += 1
+
+
+class GoodGuard:
+    def __init__(self):
+        self._g_lock = threading.Lock()
+        self._v = 0  # guarded-by: _g_lock
+
+    def bump(self):
+        with self._g_lock:
+            self._v += 1

@@ -37,6 +37,66 @@ jax.config.update("jax_platforms",
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running smoke (sanitized chaos run); excluded by "
+        "the tier-1 `-m 'not slow'` selection")
+
+
+# ---------------------------------------------------------------------------
+# graftsan: with RTPU_SANITIZE=1 every test answers for the violations
+# it produced. Two channels are drained per test: the in-process ring
+# (this process's own acquires) and the RTPU_SANITIZE_LOG artifact
+# (children inherit the env, so raylet/GCS/worker processes report
+# into the same file; a byte watermark scopes each test to its own
+# window). A violation fails the test at teardown — hard, like the
+# static pass, not a warning.
+# ---------------------------------------------------------------------------
+
+if os.environ.get("RTPU_SANITIZE") == "1":
+    os.environ.setdefault("RTPU_SANITIZE_LOG",
+                          os.path.join(os.environ.get("TMPDIR", "/tmp"),
+                                       f"graftsan-{os.getpid()}.jsonl"))
+
+    @pytest.fixture(autouse=True)
+    def _graftsan_check():
+        from ray_tpu.devtools.sanitizer import read_log, reporter
+
+        rep = reporter()
+        log = os.environ["RTPU_SANITIZE_LOG"]
+        try:
+            start = os.path.getsize(log)
+        except OSError:
+            start = 0
+        before = len(rep.snapshot())
+        yield
+        fresh = rep.snapshot()[before:]
+        logged, _ = read_log(log, start)
+        seen = {(v.kind, v.key) for v in fresh}
+        for rec in logged:
+            if (rec.get("kind"), rec.get("key")) not in seen:
+                seen.add((rec.get("kind"), rec.get("key")))
+                fresh.append(rec)
+        if fresh:
+            def _render(v):
+                if hasattr(v, "render"):
+                    return v.render()
+                out = [f"[{v.get('kind')}] (pid {v.get('pid')}) "
+                       f"{v.get('message')}"]
+                for label, stack in (v.get("stacks") or {}).items():
+                    out.append(f"  --- {label} ---")
+                    out.extend("  " + ln for ln in
+                               str(stack).rstrip().splitlines())
+                return "\n".join(out)
+
+            pytest.fail(
+                f"graftsan: {len(fresh)} concurrency-contract "
+                "violation(s) during this test:\n\n"
+                + "\n\n".join(_render(v) for v in fresh),
+                pytrace=False)
+
+
 @pytest.fixture
 def ray_start_regular():
     """A small single-host runtime (2 process workers, 8 fake TPUs)."""

@@ -458,11 +458,12 @@ def test_registered_methods_hook():
 # -- graftcheck v2: whole-program passes ------------------------------------
 
 
-def test_twelve_passes_registered():
+def test_thirteen_passes_registered():
     from ray_tpu.devtools.analysis.passes import load_passes
     ids = [p.PASS_ID for p in load_passes()]
-    assert len(ids) == 12
-    for new in ("lock-order", "blocking-under-lock", "wire-shape"):
+    assert len(ids) == 13
+    for new in ("lock-order", "blocking-under-lock", "wire-shape",
+                "sanitizer-coverage"):
         assert new in ids
 
 
@@ -774,3 +775,96 @@ def test_cached_full_suite_stays_fast():
     elapsed = _time.perf_counter() - t0
     assert unsuppressed == []
     assert elapsed < 5.0, f"cached graftcheck re-run took {elapsed:.2f}s"
+
+
+# -- graftsan: contract compilation & coverage ------------------------------
+
+
+def test_sanitizer_coverage_fixture():
+    """Each seeded rot case fires exactly once; the good twins stay
+    quiet (see the fixture's docstring for the four cases)."""
+    unsuppressed, _ = _run([_fixture("bad_sancov.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "sanitizer-coverage"]
+    assert len(hits) == 4, [f.to_json() for f in hits]
+    msgs = "\n".join(f.message for f in hits)
+    assert "orphaned" in msgs
+    assert "_t_lok" in msgs                # typo'd guarded-by lock
+    assert "_ghost_order_lock" in msgs     # unresolvable order element
+    assert "_h_lok" in msgs                # dead lock-held suppression
+    for f in hits:
+        assert "`_g_lock`" not in f.message    # good twin stays quiet
+
+
+def test_cache_prunes_deleted_files(tmp_path):
+    """A deleted file must not haunt later runs through its cached
+    summary: its cache entry is pruned and the call graph loses its
+    edges (a ghost caller would otherwise keep satisfying—or keep
+    violating—whole-program checks forever)."""
+    import shutil
+    root = tmp_path / "proj"
+    priv = root / "_private"
+    priv.mkdir(parents=True)
+    shutil.copy(_fixture("bad_lockorder.py"), priv / "bad_lockorder.py")
+    (priv / "extra.py").write_text(
+        "import threading\n\n\n"
+        "class Extra:\n"
+        "    def __init__(self):\n"
+        "        self._e_lock = threading.Lock()\n"
+        "        self._f_lock = threading.Lock()\n\n"
+        "    def nest(self):\n"
+        "        with self._e_lock:\n"
+        "            with self._f_lock:\n"
+        "                return 1\n")
+    first, _ = _run([str(root)], root=str(root), use_cache=True)
+    cache_path = root / ".rtpu_analysis_cache.json"
+    cached = json.load(open(cache_path))["files"]
+    assert any("extra.py" in p for p in cached)
+    (priv / "extra.py").unlink()
+    second, _ = _run([str(root)], root=str(root), use_cache=True)
+    cached = json.load(open(cache_path))["files"]
+    assert not any("extra.py" in p for p in cached), (
+        "deleted file's summary still cached")
+    # the survivor's findings are unchanged — no ghost edges either way
+    assert ([f.to_json() for f in second]
+            == [f.to_json() for f in first
+                if "extra.py" not in f.path])
+
+
+def test_contract_manifest_in_sync():
+    """The committed contracts.json must equal what --emit-contracts
+    produces from the current tree: annotations changed without
+    re-emitting would hand graftsan a stale contract."""
+    from ray_tpu.devtools.analysis import contracts
+
+    path = contracts.default_manifest_path()
+    assert os.path.exists(path), (
+        "no committed contract manifest; run "
+        "`python -m ray_tpu.devtools.analysis --emit-contracts`")
+    fresh = contracts.render_manifest(contracts.emit_contracts())
+    with open(path, encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == fresh, (
+        "contracts.json is stale — re-run "
+        "`python -m ray_tpu.devtools.analysis --emit-contracts`")
+
+
+def test_contract_manifest_contents():
+    """Schema spot-checks on the committed manifest: the declared
+    orders, the guarded map, and the designed `# blocking-ok:` escapes
+    all survive compilation with class-qualified identities."""
+    from ray_tpu.devtools.analysis import contracts
+
+    m = contracts.load_manifest()
+    assert m is not None and m["version"] == contracts.MANIFEST_VERSION
+    order_nodes = [tuple(o["nodes"]) for o in m["orders"]]
+    assert ("RayletServer._push_order_lock", "RayletServer._push_lock",
+            "ConnectionContext._send_lock") in order_nodes
+    assert ("Worker._gang_lock", "Worker._actor_lock") in order_nodes
+    router = m["guarded"]["ray_tpu/serve/_private/router.py"]
+    assert router["ReplicaSet"]["_replicas"] == "_lock"
+    assert router["ReplicaSet"]["_inflight"] == "_lock"
+    sites = m["lock_sites"]
+    escapes = {v["name"]: v.get("escape") for v in sites.values()}
+    assert escapes.get("ConnectionContext._send_lock"), (
+        "_send_lock must carry its designed blocking-ok escape")
+    assert m["chaos_points"], "chaos fire() sites must be compiled"
