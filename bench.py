@@ -824,6 +824,89 @@ def bench_multislice():
     return out
 
 
+def bench_data():
+    """Streaming data plane (docs/data_pipeline.md): block throughput
+    through a read -> map -> map pipeline consumed incrementally, and
+    the trainer-ingestion starvation fraction with a 2-slice trainer
+    fed by ``run_with_data``. Runtime-plane numbers — subprocess'd
+    like e2e/serve, and honest the same way: deltas are same-box
+    same-session only."""
+    out = {}
+    ROWS_PER_BLOCK = 4096
+    NUM_BLOCKS = 48
+
+    try:
+        import ray_tpu
+        from ray_tpu import data as rdata
+        ray_tpu.init(num_cpus=8, num_tpus=8, max_process_workers=4)
+        try:
+            def pipeline():
+                ds = rdata.range(NUM_BLOCKS * ROWS_PER_BLOCK,
+                                 parallelism=NUM_BLOCKS)
+                ds = ds.map_batches(lambda b: {"id": b["id"] * 2})
+                return ds.map_batches(
+                    lambda b: {"id": b["id"] + 1})
+
+            # warm the worker pool (spawn cost is seconds; steady
+            # state is what the pipeline runs at)
+            for _ in pipeline().iter_batches(batch_size=ROWS_PER_BLOCK):
+                pass
+
+            from ray_tpu._private import data_stats
+            before = data_stats.snapshot()
+            t0 = time.perf_counter()
+            nrows = 0
+            for batch in pipeline().iter_batches(
+                    batch_size=ROWS_PER_BLOCK, prefetch_batches=2):
+                nrows += len(batch["id"])
+            dt = time.perf_counter() - t0
+            after = data_stats.snapshot()
+            blocks = (after["blocks_produced"]
+                      - before["blocks_produced"])
+            nbytes = (after["bytes_produced"]
+                      - before["bytes_produced"])
+            out["data_blocks_per_sec"] = round(blocks / dt, 1)
+            out["data_bytes_per_sec"] = int(nbytes / dt)
+            out["data_rows_per_sec"] = int(nrows / dt)
+
+            # trainer ingestion: starvation fraction of a 2-slice
+            # trainer fed off the pipeline with prefetch
+            from ray_tpu.train.multislice import (MultiSliceConfig,
+                                                  MultiSliceTrainer)
+
+            def init_fn():
+                return np.zeros(8)
+
+            def grad_fn(state, rank, world, step, batch):
+                return np.full(8, float(np.asarray(
+                    batch["id"], dtype=np.float64).mean()))
+
+            def apply_fn(state, synced):
+                state = state + synced
+                return state, float(state[0])
+
+            tr = MultiSliceTrainer(
+                init_fn, grad_fn, apply_fn,
+                MultiSliceConfig(num_slices=2, ranks_per_slice=1,
+                                 resources_per_worker={"CPU": 1.0}))
+            tr.start()
+            tr.run_with_data(
+                pipeline().iter_batches(batch_size=ROWS_PER_BLOCK,
+                                        batch_format="numpy"),
+                prefetch_batches=2)
+            out["data_trainer_starvation_fraction"] = round(
+                tr.last_ingest["starvation_fraction"], 4)
+            out["data_trainer_steps_per_sec"] = round(
+                tr.last_ingest["steps"]
+                / max(tr.last_ingest["wall_s"], 1e-9), 1)
+            tr.shutdown()
+        finally:
+            ray_tpu.shutdown()
+    except Exception as e:
+        print(f"# data bench failed: {e!r}", file=sys.stderr)
+    return out
+
+
 def bench_model_mfu():
     """Flagship-transformer training-step time and MFU% on the real
     chip. K steps run inside ONE jitted lax.scan (with the state
@@ -1017,6 +1100,7 @@ def main():
     record.update(_run_section_subprocess("--wire"))
     record.update(_run_section_subprocess("--serve"))
     record.update(_run_section_subprocess("--multislice"))
+    record.update(_run_section_subprocess("--data"))
     record.update(bench_model_mfu())
     print(json.dumps(record))
     print(f"# scheduled {n_scheduled} of {N_TASKS} pending; "
@@ -1035,5 +1119,7 @@ if __name__ == "__main__":
         print(json.dumps(bench_serve()))
     elif "--multislice" in sys.argv:
         print(json.dumps(bench_multislice()))
+    elif "--data" in sys.argv:
+        print(json.dumps(bench_data()))
     else:
         main()

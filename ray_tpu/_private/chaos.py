@@ -89,7 +89,8 @@ KILL_EXIT_CODE = 42
 
 ACTIONS = ("drop", "delay", "dup", "sever", "kill", "pressure")
 POINTS = ("send", "recv", "dispatch", "spawn", "teardown", "boot",
-          "exec", "watchdog", "rendezvous", "checkpoint", "dcn", "*")
+          "exec", "watchdog", "rendezvous", "checkpoint", "dcn",
+          "map", "*")
 
 _RULE_RE = re.compile(
     r"^(?P<component>[^.:\s]+)\.(?P<point>[^.:\s]+)\.(?P<method>[^:\s]*)"

@@ -252,6 +252,25 @@ _D("serve_zero_copy_threshold_bytes", int, 65536,
    "re-pickling the payload; the replica reads it zero-copy from "
    "shm. 0 disables ref promotion.")
 
+# --- streaming data plane (docs/data_pipeline.md) ---
+_D("data_block_target_bytes", int, 64 * 1024 * 1024,
+   "Map outputs larger than this split into multiple row-sliced "
+   "blocks inside the producing task (dynamic block splitting), so "
+   "no single object outgrows the store's comfort zone and "
+   "downstream stages parallelize over the pieces.")
+_D("data_max_in_flight", int, 8,
+   "Count cap on concurrently running tasks per map stage (the byte "
+   "budget is the primary backpressure signal; this is the fallback "
+   "concurrency bound).")
+_D("data_prefetch_batches", int, 2,
+   "Batches buffered ahead of the consumer by the prefetching "
+   "iterators (iter_batches(prefetch_batches=...) defaults, trainer "
+   "ingestion). 0 disables prefetch.")
+_D("data_max_block_retries", int, 3,
+   "Re-drives of one input block after its map task/actor died "
+   "mid-block (data-plane lineage reconstruction). Exceeding the "
+   "budget surfaces the last typed error to the consumer.")
+
 # --- overload plane (reference: memory monitor + backpressured
 # submission; see docs/fault_tolerance.md "Overload semantics") ---
 _D("raylet_max_queued_tasks", int, 4096,

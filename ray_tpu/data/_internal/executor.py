@@ -22,11 +22,19 @@ from __future__ import annotations
 import logging
 import math
 from collections import deque
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 import ray_tpu
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorError,
+    BackpressureError,
+    ObjectLostError,
+    WorkerCrashedError,
+)
+from ray_tpu._private import data_stats
 from ray_tpu.data._internal.plan import (
     AllToAllStage,
     LimitStage,
@@ -90,9 +98,11 @@ def _split_oversized(blk, target_bytes: int):
 
 @ray_tpu.remote
 def _map_chain_task(transforms: List[MapTransform], target_bytes: int,
-                    blk):
+                    stage_name: str, blk):
     """Streaming map task: yields one block normally, several when the
     output exceeds ``target_bytes``."""
+    from ray_tpu._private import chaos
+    chaos.fire("data", "map", stage_name)
     for t in transforms:
         blk = _apply_transform(t, blk)
     yield from _split_oversized(blk, target_bytes)
@@ -108,7 +118,9 @@ class _MapWorker:
     """Actor-pool worker: instantiates the user's callable class once,
     reuses it per block (reference: ActorPoolMapOperator)."""
 
-    def __init__(self, transforms: List[MapTransform]):
+    def __init__(self, transforms: List[MapTransform],
+                 stage_name: str = ""):
+        self._stage_name = stage_name
         self._transforms = []
         for t in transforms:
             fn = t.fn
@@ -120,6 +132,8 @@ class _MapWorker:
             self._transforms.append(t)
 
     def apply(self, target_bytes: int, blk):
+        from ray_tpu._private import chaos
+        chaos.fire("data", "map", self._stage_name)
         for t in self._transforms:
             blk = _apply_transform(t, blk)
         yield from _split_oversized(blk, target_bytes)
@@ -245,18 +259,27 @@ def _sample_task(blk, key: str, k: int):
 # Streaming loop
 # --------------------------------------------------------------------------
 
+def _ref_entry(ref):
+    """The owner-directory entry of a resolved driver-owned block ref
+    (None when unknown/unresolved) — the locality and size signals the
+    budgets and the block router run on. No block fetch involved."""
+    from ray_tpu._private.worker import try_global_worker
+    w = try_global_worker()
+    if w is None or not hasattr(w, "memory_store"):
+        return None
+    try:
+        return w.memory_store.get(ref.id(), timeout=0)
+    except TimeoutError:
+        return None
+
+
 def _ref_nbytes(ref) -> int:
     """Stored size of a resolved driver-owned block ref (0 when
     unknown): the byte signal the backpressure budgets run on — block
     sizes are known at ref-resolution time from the owner's directory,
     no block fetch involved."""
-    from ray_tpu._private.worker import try_global_worker
-    w = try_global_worker()
-    if w is None or not hasattr(w, "memory_store"):
-        return 0
-    try:
-        entry = w.memory_store.get(ref.id(), timeout=0)
-    except TimeoutError:
+    entry = _ref_entry(ref)
+    if entry is None:
         return 0
     try:
         if entry.kind in ("shm", "remote"):
@@ -268,23 +291,66 @@ def _ref_nbytes(ref) -> int:
     return 0
 
 
+def _ref_node(ref):
+    """NodeID holding the block's bytes, or None when the block is
+    driver-local (shm/inline — equally cheap from any local raylet) or
+    unresolved. The locality router prefers dispatching to an actor on
+    this node so the bytes never cross the interconnect."""
+    entry = _ref_entry(ref)
+    if entry is not None and entry.kind == "remote":
+        try:
+            return entry.data[0]
+        except Exception:
+            return None
+    return None
+
+
+def _ref_zero_copy(ref) -> bool:
+    """True when the stored block rides the shm mmap path (PR-7): a
+    consumer on the holding host maps the bytes instead of copying
+    them. Inline blobs (small blocks) re-pickle per consumer."""
+    entry = _ref_entry(ref)
+    return entry is not None and entry.kind in ("shm", "remote")
+
+
+# Typed system-fault taxonomy the block re-drive loop treats as
+# retryable: the map worker (or the node holding its output) died
+# before the stream committed. Deterministic user-code errors
+# (TaskError and friends) surface immediately — burning the retry
+# budget on them would just repeat the traceback. ConnectionError
+# covers a severed transfer surfacing through a raw socket.
+_RETRYABLE_BLOCK_ERRORS = (ActorError, WorkerCrashedError,
+                           ObjectLostError, ConnectionError)
+
+
 class _MapRuntime:
     def __init__(self, stage: MapStage, max_in_flight: int,
-                 target_block_bytes: int):
+                 target_block_bytes: int, max_block_retries: int = 3):
         self.stage = stage
         self.target_block_bytes = target_block_bytes
-        self.inputs: deque = deque()              # (ref, seq, nbytes)
+        self.max_block_retries = max_block_retries
+        # (ref, seq, nbytes) triples; fed only while the upstream
+        # budget check passes — queued_bytes() is fenced under the
+        # per-stage byte budget by launch gating, the real bound here
+        # unbounded-ok: launch-gated under the per-stage byte budget
+        self.inputs: deque = deque()
         self.in_flight: Dict[Any, int] = {}       # done-marker ref -> seq
         self._gen_task: Dict[int, Any] = {}       # seq -> stream TaskID
-        self._inflight_bytes: Dict[Any, int] = {}  # done ref -> input bytes
+        # done ref -> (input ref, seq, nbytes): retained until the
+        # stream commits so a dead worker's block can be re-driven
+        self._inflight_input: Dict[Any, Tuple] = {}
+        self._retries: Dict[int, int] = {}        # seq -> re-drives used
         self.ready: Dict[int, List] = {}          # seq -> [refs] in order
         self._ready_nbytes: Dict[int, int] = {}   # seq -> output bytes
         self.next_in_seq = 0
         self.next_out_seq = 0
         self.input_done = False
         self.max_in_flight = max_in_flight
+        self.num_reconstructions = 0
+        self.last_backpressure: Optional[BackpressureError] = None
         self.actors: List = []
         self.actor_busy: Dict[int, int] = {}      # actor idx -> in-flight
+        self._actor_nodes: Dict[int, Any] = {}    # actor idx -> NodeID
         self._ref_actor: Dict[Any, int] = {}
 
     def add_input(self, ref, seq: int) -> None:
@@ -294,7 +360,7 @@ class _MapRuntime:
         """Bytes parked at this stage (queued inputs + inputs of
         running tasks): the signal upstream gates on."""
         return (sum(nb for _r, _s, nb in self.inputs)
-                + sum(self._inflight_bytes.values()))
+                + sum(nb for _r, _s, nb in self._inflight_input.values()))
 
     def ready_bytes(self) -> int:
         """Bytes of completed outputs not yet handed downstream — the
@@ -304,34 +370,121 @@ class _MapRuntime:
         store lookups."""
         return sum(self._ready_nbytes.values())
 
+    def _spread_strategies(self) -> List:
+        """One soft NodeAffinity per alive node, round-robin — pool
+        actors land where blocks may live instead of piling onto the
+        head raylet. Soft: a full node falls back to any placement."""
+        from ray_tpu._private.worker import try_global_worker
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        w = try_global_worker()
+        if w is None:
+            return []
+        try:
+            alive = [i.node_id for i in w.gcs.get_all_node_info()
+                     if i.alive]
+        except Exception:
+            return []
+        if len(alive) <= 1:
+            return []
+        return [NodeAffinitySchedulingStrategy(nid.hex(), soft=True)
+                for nid in alive]
+
     def ensure_actors(self):
         if self.stage.uses_actors and not self.actors:
             n = self.stage.concurrency or 2
-            opts = dict(self.stage.resources)
-            kw = {}
-            if "CPU" in opts:
-                kw["num_cpus"] = opts["CPU"]
-            if "TPU" in opts:
-                kw["num_tpus"] = opts["TPU"]
+            spread = self._spread_strategies()
             self.actors = [
-                _MapWorker.options(**kw).remote(self.stage.transforms)
-                for _ in range(n)]
+                self._spawn_actor(spread[i % len(spread)]
+                                  if spread else None)
+                for i in range(n)]
             self.actor_busy = {i: 0 for i in range(len(self.actors))}
 
-    def launch(self, budget_ok=None):
+    def _spawn_actor(self, strategy=None):
+        opts = dict(self.stage.resources)
+        kw = {}
+        if "CPU" in opts:
+            kw["num_cpus"] = opts["CPU"]
+        if "TPU" in opts:
+            kw["num_tpus"] = opts["TPU"]
+        if strategy is not None:
+            kw["scheduling_strategy"] = strategy
+        # the pool restarts a chaos-killed worker in place (fresh
+        # process, same handle — queued calls flush once it is back);
+        # the per-block retry budget bounds the re-drive loop on top
+        return _MapWorker.options(max_restarts=4, **kw).remote(
+            self.stage.transforms, self.stage.name)
+
+    def _actor_node(self, idx):
+        """NodeID hosting pool actor ``idx`` (cached once placed)."""
+        node = self._actor_nodes.get(idx)
+        if node is None:
+            from ray_tpu._private.worker import try_global_worker
+            w = try_global_worker()
+            if w is not None:
+                try:
+                    node = w.node_group.actor_node(
+                        self.actors[idx]._actor_id)
+                except Exception:
+                    node = None
+                if node is not None:
+                    self._actor_nodes[idx] = node
+        return node
+
+    def _pick_actor(self, blk_ref) -> int:
+        """Locality-aware routing: among the pool, prefer the
+        least-busy actor CO-LOCATED with the block's bytes; fall back
+        to global least-busy. Only counts as a locality decision when
+        the block actually lives on some node (remote entries)."""
+        best = min(self.actor_busy, key=self.actor_busy.get)
+        node = _ref_node(blk_ref)
+        if node is None:
+            return best
+        local = [i for i in self.actor_busy
+                 if self._actor_node(i) == node]
+        if local:
+            cand = min(local, key=self.actor_busy.get)
+            # don't pile onto a local-but-saturated worker when an
+            # idle remote one exists: locality saves one block copy,
+            # a stalled pool slot costs a whole block's compute
+            if self.actor_busy[cand] <= self.actor_busy[best] + 2:
+                data_stats.incr("locality_hits")
+                return cand
+        data_stats.incr("locality_misses")
+        return best
+
+    def launch(self, budget_check=None):
         """Start tasks while the count cap AND the downstream byte
-        budget allow (``budget_ok`` closes over the downstream stage's
-        queued bytes — memory-aware backpressure)."""
+        budget allow. ``budget_check`` raises a typed
+        :class:`BackpressureError` (PR-3 overload taxonomy) when the
+        downstream stage's queued bytes exceed its budget — the signal
+        is recorded (observable via ``last_backpressure`` and the
+        ``backpressure_events`` counter) and upstream launching stops
+        until the downstream drains."""
         self.ensure_actors()
         while self.inputs and len(self.in_flight) < self.max_in_flight:
-            if budget_ok is not None and not budget_ok():
-                return
+            if budget_check is not None:
+                try:
+                    budget_check()
+                except BackpressureError as e:
+                    self.last_backpressure = e
+                    data_stats.incr("backpressure_events")
+                    return
             blk_ref, seq, nbytes = self.inputs.popleft()
             if self.stage.uses_actors:
-                idx = min(self.actor_busy, key=self.actor_busy.get)
-                gen = self.actors[idx].apply.options(
-                    num_returns="streaming").remote(
-                        self.target_block_bytes, blk_ref)
+                idx = self._pick_actor(blk_ref)
+                try:
+                    gen = self.actors[idx].apply.options(
+                        num_returns="streaming").remote(
+                            self.target_block_bytes, blk_ref)
+                except ActorDiedError:
+                    # restart budget exhausted: replace the pool slot
+                    # with a fresh worker and re-dispatch there
+                    self.actors[idx] = self._spawn_actor()
+                    self._actor_nodes.pop(idx, None)
+                    gen = self.actors[idx].apply.options(
+                        num_returns="streaming").remote(
+                            self.target_block_bytes, blk_ref)
                 self.actor_busy[idx] += 1
                 self._ref_actor[gen.completed()] = idx
             else:
@@ -341,31 +494,75 @@ class _MapRuntime:
                     kw["num_cpus"] = res["CPU"]
                 if "TPU" in res:
                     kw["num_tpus"] = res["TPU"]
+                node = _ref_node(blk_ref)
+                if node is not None:
+                    from ray_tpu.util.scheduling_strategies import (
+                        NodeAffinitySchedulingStrategy)
+                    kw["scheduling_strategy"] = \
+                        NodeAffinitySchedulingStrategy(node.hex(),
+                                                       soft=True)
                 gen = _map_chain_task.options(
                     num_returns="streaming", **kw).remote(
                         self.stage.transforms, self.target_block_bytes,
-                        blk_ref)
+                        self.stage.name, blk_ref)
             done_ref = gen.completed()
             self.in_flight[done_ref] = seq
             self._gen_task[seq] = done_ref.id().task_id()
-            self._inflight_bytes[done_ref] = nbytes
+            self._inflight_input[done_ref] = (blk_ref, seq, nbytes)
 
     def complete(self, ref):
         """A map task's stream finished: expand its item refs (split
-        outputs land as separate driver-owned blocks, indices 2..)."""
+        outputs land as separate driver-owned blocks, indices 2..).
+
+        Fault-tolerant blocks: item refs are expanded ONLY after the
+        stream's commit marker resolves cleanly, and the input ref is
+        retained until then — so a worker death mid-block re-drives
+        the WHOLE block from its input (exactly-once at block
+        granularity: the aborted attempt's partial outputs are never
+        handed downstream, the re-driven attempt's outputs are handed
+        exactly once, in the original seq order)."""
         from ray_tpu._private.ids import ObjectID
         from ray_tpu._private.object_ref import ObjectRef
         seq = self.in_flight.pop(ref)
-        self._inflight_bytes.pop(ref, None)
+        blk_ref, _seq, nbytes = self._inflight_input.pop(ref)
         idx = self._ref_actor.pop(ref, None)
         if idx is not None:
             self.actor_busy[idx] -= 1
         task_id = self._gen_task.pop(seq)
-        count = ray_tpu.get(ref)      # raises the task's error, if any
+        try:
+            count = ray_tpu.get(ref)  # raises the task's error, if any
+        except _RETRYABLE_BLOCK_ERRORS as e:
+            self._requeue(blk_ref, seq, nbytes, e)
+            return
         refs = [ObjectRef(ObjectID.from_index(task_id, i + 2))
                 for i in range(count)]
         self.ready[seq] = refs
         self._ready_nbytes[seq] = sum(_ref_nbytes(r) for r in refs)
+        self._retries.pop(seq, None)
+        data_stats.incr("blocks_produced", len(refs))
+        data_stats.incr("bytes_produced", self._ready_nbytes[seq])
+        zc = sum(1 for r in refs if _ref_zero_copy(r))
+        if zc:
+            data_stats.incr("zero_copy_blocks", zc)
+
+    def _requeue(self, blk_ref, seq: int, nbytes: int,
+                 err: BaseException) -> None:
+        """Data-plane lineage: put the dead attempt's INPUT back at the
+        head of the queue (seq order preserved — downstream ordering
+        never observes the fault). The input ref itself may need core
+        lineage reconstruction too (its bytes died with the worker);
+        that path is the arg-localization retry, not ours."""
+        used = self._retries.get(seq, 0)
+        if used >= self.max_block_retries:
+            raise err
+        self._retries[seq] = used + 1
+        self.num_reconstructions += 1
+        data_stats.incr("blocks_reconstructed")
+        logger.warning(
+            "data stage %s: block seq=%d re-driven after %r "
+            "(attempt %d/%d)", self.stage.name, seq, err, used + 1,
+            self.max_block_retries)
+        self.inputs.appendleft((blk_ref, seq, nbytes))
 
     def pop_ready_in_order(self):
         out = []
@@ -409,7 +606,21 @@ class StreamingExecutor:
         self._max_in_flight = max_in_flight or ctx.max_in_flight
         self._target_block_bytes = ctx.target_max_block_size
         self._budget_override = ctx.per_stage_memory_budget
+        self._max_block_retries = ctx.max_block_retries
         self._name = name
+        # live per-stage runtimes of the currently running segment —
+        # what the ray_tpu_data_queued_bytes{stage} gauge reads; empty
+        # between segments and after completion, so the series return
+        # to baseline when the pipeline finishes
+        self._live: List[Tuple[str, _MapRuntime]] = []
+        self.num_reconstructions = 0
+        data_stats.register_executor(self)
+
+    def queued_bytes_by_stage(self) -> Dict[str, int]:
+        """Per-stage parked bytes (queued + in-flight inputs, plus
+        completed-unconsumed outputs) of the live segment."""
+        return {label: rt.queued_bytes() + rt.ready_bytes()
+                for label, rt in list(self._live)}
 
     def _per_stage_budget(self, n_stages: int) -> int:
         if self._budget_override:
@@ -423,9 +634,12 @@ class StreamingExecutor:
     def output_refs(self) -> Iterator[Any]:
         plan = self._plan
         # Materialize source refs for this run: launch read tasks
-        # incrementally; extra (union) sources are chained after.
-        source: deque = deque()
-        pending_reads: deque = deque(plan.read_tasks)
+        # incrementally; extra (union) sources are chained after. Both
+        # deques hold the plan's fixed source/read lists — sized at
+        # plan construction, only drained during streaming.
+        source: deque = deque()  # unbounded-ok: plan-sized, drain-only
+        pending_reads: deque = deque(
+            plan.read_tasks)     # unbounded-ok: plan-sized, drain-only
         source.extend(plan.source_refs)
         for extra in plan.extra_sources:
             if extra.stages:
@@ -453,12 +667,15 @@ class StreamingExecutor:
         for st in map_stages:
             if isinstance(st, MapStage):
                 rt = _MapRuntime(st, self._max_in_flight,
-                                 self._target_block_bytes)
+                                 self._target_block_bytes,
+                                 self._max_block_retries)
                 runtimes.append(rt)
                 pipeline.append(rt)
             elif isinstance(st, LimitStage):
                 limit_remaining[id(st)] = st.n
                 pipeline.append(st)
+        self._live = [(f"{i}:{rt.stage.name}", rt)
+                      for i, rt in enumerate(runtimes)]
 
         budget = self._per_stage_budget(max(1, len(runtimes)))
         # each stage's launches gate on its DOWNSTREAM stage's queued
@@ -468,13 +685,33 @@ class StreamingExecutor:
             downstream_of[id(rt)] = (runtimes[i + 1]
                                      if i + 1 < len(runtimes) else None)
 
-        def budget_ok_for(rt: _MapRuntime):
+        def budget_check_for(rt: _MapRuntime):
+            """The typed throttle: raises BackpressureError (PR-3
+            overload taxonomy, retryable by construction — nothing was
+            launched) when the downstream stage is over budget."""
             ds = downstream_of.get(id(rt))
-            if ds is None:
-                # terminal stage: gate on its own completed-unconsumed
-                # output bytes (the consumer's pace, in bytes)
-                return lambda: rt.ready_bytes() < budget
-            return lambda: ds.queued_bytes() < budget
+
+            def check():
+                if ds is None:
+                    # terminal stage: gate on its own completed-
+                    # unconsumed output bytes (the consumer's pace)
+                    parked, where = rt.ready_bytes(), "output"
+                else:
+                    # downstream queue PLUS this stage's own completed
+                    # outputs still parked behind the ordered handoff:
+                    # a straggling low-seq task head-of-line blocks
+                    # pop_ready_in_order, so ready bytes accumulate
+                    # here while the downstream queue reads empty —
+                    # they are downstream-destined bytes either way
+                    parked = ds.queued_bytes() + rt.ready_bytes()
+                    where = "downstream"
+                if parked >= budget:
+                    raise BackpressureError(
+                        f"data stage {rt.stage.name}: {where} holds "
+                        f"{parked} queued bytes >= budget {budget}; "
+                        "upstream launches throttled",
+                        retryable=True, backoff_s=0.05)
+            return check
 
         read_in_flight: Dict[Any, int] = {}
         read_seq = 0
@@ -482,7 +719,11 @@ class StreamingExecutor:
         stop = False
 
         def reads_allowed() -> bool:
-            return not runtimes or runtimes[0].queued_bytes() < budget
+            if not runtimes:
+                return True
+            first = runtimes[0]
+            # queued + parked-ready: the first stage's full footprint
+            return first.queued_bytes() + first.ready_bytes() < budget
 
         def feed_first(ref):
             nonlocal stop
@@ -498,7 +739,14 @@ class StreamingExecutor:
             else:
                 emitted.append(ref)
 
+        def consumed(ref):
+            data_stats.incr("blocks_consumed")
+            return ref
+
         # ---- streaming loop ----
+        # drained to empty at the bottom of every loop iteration;
+        # holds at most one iteration's ordered outputs
+        # unbounded-ok: drained to empty every loop iteration
         out_queue: deque = deque()
         try:
             while True:
@@ -514,13 +762,13 @@ class StreamingExecutor:
                     feed_first(source.popleft())
                 # 2. launch map work (downstream byte budget)
                 for rt in runtimes:
-                    rt.launch(budget_ok_for(rt))
+                    rt.launch(budget_check_for(rt))
                 # 3. wait for anything
                 all_refs = (list(read_in_flight)
                             + [r for rt in runtimes for r in rt.in_flight])
                 if not all_refs:
                     while emitted:
-                        yield emitted.pop(0)
+                        yield consumed(emitted.pop(0))
                     if (stop or not pending_reads) and all(
                             rt.done for rt in runtimes):
                         break
@@ -531,6 +779,9 @@ class StreamingExecutor:
                 for ref in ready:
                     if ref in read_in_flight:
                         read_in_flight.pop(ref)
+                        data_stats.incr("blocks_produced")
+                        data_stats.incr("bytes_produced",
+                                        _ref_nbytes(ref))
                         feed_first(ref)
                         continue
                     for i, rt in enumerate(runtimes):
@@ -568,8 +819,11 @@ class StreamingExecutor:
                 while emitted:
                     out_queue.append(emitted.pop(0))
                 while out_queue:
-                    yield out_queue.popleft()
+                    yield consumed(out_queue.popleft())
         finally:
+            self.num_reconstructions += sum(
+                rt.num_reconstructions for rt in runtimes)
+            self._live = []
             for rt in runtimes:
                 rt.shutdown()
 
@@ -597,8 +851,10 @@ class StreamingExecutor:
         segmenting the plan."""
         plan = self._plan
         stages = list(plan.stages)
-        segment_source = deque(plan.source_refs)
-        pending_reads = deque(plan.read_tasks)
+        segment_source = deque(
+            plan.source_refs)    # unbounded-ok: plan-sized, drain-only
+        pending_reads = deque(
+            plan.read_tasks)     # unbounded-ok: plan-sized, drain-only
         extra = plan.extra_sources
 
         while True:
@@ -617,14 +873,22 @@ class StreamingExecutor:
             seg_exec = StreamingExecutor(seg_plan,
                                          max_in_flight=self._max_in_flight)
             if barrier_idx is None:
-                yield from seg_exec.output_refs()
+                try:
+                    yield from seg_exec.output_refs()
+                finally:
+                    self.num_reconstructions += \
+                        seg_exec.num_reconstructions
                 return
             # barrier: drain segment, run the all-to-all, continue
             upstream_refs = list(seg_exec.output_refs())
+            self.num_reconstructions += seg_exec.num_reconstructions
             barrier = stages[barrier_idx]
+            # unbounded-ok: the barrier's output partitions — fixed
+            # fan-out decided by the all-to-all, drained by the next
+            # segment; the empty read deque never grows
             segment_source = deque(
                 self._run_all_to_all(barrier, upstream_refs))
-            pending_reads = deque()
+            pending_reads = deque()  # unbounded-ok: stays empty
             stages = stages[barrier_idx + 1:]
 
     def _run_all_to_all(self, stage: AllToAllStage, refs: List) -> List:

@@ -2,8 +2,12 @@
 
 Reference: ``python/ray/data/context.py`` (``DataContext.get_current``)
 [UNVERIFIED — mount empty, SURVEY.md §0] — the knobs the streaming
-executor reads: target block size for dynamic splitting and the
-per-stage memory budget for byte-aware backpressure.
+executor reads: target block size for dynamic splitting, the
+per-stage memory budget for byte-aware backpressure, the per-block
+retry budget for data-plane reconstruction, and the prefetch depth
+for the consuming iterators. Defaults come from the system config
+(``data_*`` knobs, docs/data_pipeline.md §Knobs) at first use, so
+``RAY_TPU_data_block_target_bytes=...`` et al. work without code.
 """
 
 from __future__ import annotations
@@ -25,6 +29,10 @@ class DataContext:
     per_stage_memory_budget: Optional[int] = None
     # Fallback count cap on concurrently running tasks per stage.
     max_in_flight: int = 8
+    # Batches buffered ahead of the consumer by prefetching iterators.
+    prefetch_batches: int = 2
+    # Re-drives of one input block after its map worker died mid-block.
+    max_block_retries: int = 3
 
     _current: ClassVar[Optional["DataContext"]] = None
     _lock: ClassVar[threading.Lock] = threading.Lock()
@@ -33,5 +41,11 @@ class DataContext:
     def get_current(cls) -> "DataContext":
         with cls._lock:
             if cls._current is None:
-                cls._current = DataContext()
+                from ray_tpu._private.config import get_config
+                cfg = get_config()
+                cls._current = DataContext(
+                    target_max_block_size=cfg.data_block_target_bytes,
+                    max_in_flight=cfg.data_max_in_flight,
+                    prefetch_batches=cfg.data_prefetch_batches,
+                    max_block_retries=cfg.data_max_block_retries)
             return cls._current

@@ -156,8 +156,32 @@ class Dataset:
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy",
-                     drop_last: bool = False) -> Iterator[Any]:
-        """Stream batches; blocks are re-chunked to batch_size."""
+                     drop_last: bool = False,
+                     prefetch_batches: int = 0) -> Iterator[Any]:
+        """Stream batches; blocks are re-chunked to batch_size.
+
+        Consumption is INCREMENTAL: batches come off the streaming
+        executor as blocks complete — the first batch arrives while
+        later blocks are still being produced, never after a full
+        materialization. ``prefetch_batches > 0`` additionally runs
+        the pipeline on a background thread with that many batches
+        buffered ahead (docs/data_pipeline.md §Prefetch)."""
+        if prefetch_batches and prefetch_batches > 0:
+            from ray_tpu.data._internal.prefetch import PrefetchIterator
+            pf = PrefetchIterator(
+                self._iter_batches_local(batch_size, batch_format,
+                                         drop_last),
+                depth=prefetch_batches)
+            try:
+                yield from pf
+            finally:
+                pf.close()
+            return
+        yield from self._iter_batches_local(batch_size, batch_format,
+                                            drop_last)
+
+    def _iter_batches_local(self, batch_size, batch_format,
+                            drop_last) -> Iterator[Any]:
         carry: List[blib.Block] = []
         carry_rows = 0
         for blk in self.iter_blocks():
@@ -183,13 +207,21 @@ class Dataset:
 
     def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
                            dtypes=None, device: Optional[str] = None,
-                           drop_last: bool = False) -> Iterator[Any]:
+                           drop_last: bool = False,
+                           prefetch_batches: Optional[int] = None
+                           ) -> Iterator[Any]:
         """numpy batches converted to torch tensors (reference:
-        ``Dataset.iter_torch_batches`` feeding TorchTrainer loops)."""
+        ``Dataset.iter_torch_batches`` feeding TorchTrainer loops).
+        ``prefetch_batches`` defaults to the DataContext setting —
+        device-feeding loops want execution overlapped with the step."""
         import torch
+        if prefetch_batches is None:
+            from ray_tpu.data.context import DataContext
+            prefetch_batches = DataContext.get_current().prefetch_batches
         for batch in self.iter_batches(batch_size=batch_size,
                                        batch_format="numpy",
-                                       drop_last=drop_last):
+                                       drop_last=drop_last,
+                                       prefetch_batches=prefetch_batches):
             out = {}
             for key, arr in batch.items():
                 t = torch.as_tensor(arr)
@@ -204,13 +236,22 @@ class Dataset:
 
     def iter_jax_batches(self, *, batch_size: Optional[int] = 256,
                          sharding=None,
-                         drop_last: bool = False) -> Iterator[Any]:
+                         drop_last: bool = False,
+                         prefetch_batches: Optional[int] = None
+                         ) -> Iterator[Any]:
         """numpy batches placed as jax arrays, optionally with a
-        target sharding (feeds pjit train steps directly)."""
+        target sharding (feeds pjit train steps directly).
+        ``prefetch_batches`` defaults to the DataContext setting
+        (``data_prefetch_batches``): the pipeline runs ahead of the
+        train step so the trainer never starves on block production."""
         import jax
+        if prefetch_batches is None:
+            from ray_tpu.data.context import DataContext
+            prefetch_batches = DataContext.get_current().prefetch_batches
         for batch in self.iter_batches(batch_size=batch_size,
                                        batch_format="numpy",
-                                       drop_last=drop_last):
+                                       drop_last=drop_last,
+                                       prefetch_batches=prefetch_batches):
             if sharding is None:
                 yield {k: jax.numpy.asarray(v) for k, v in batch.items()}
             else:

@@ -30,10 +30,10 @@ from ray_tpu.devtools.analysis.core import (FileContext, Finding,
                                             suppressed_by_mark)
 
 PASS_ID = "bounded-queue"
-VERSION = 7   # v7: serve plane (router/controller/proxy/replica)
+VERSION = 8   # v8: streaming data plane (ray_tpu/data/)
 
 _SCOPES = ("_private/", "collective/", "multislice/",
-           "serve/", "analysis_fixtures/")
+           "serve/", "data/", "analysis_fixtures/")
 
 _SUPPRESS_MARK = "unbounded-ok:"
 

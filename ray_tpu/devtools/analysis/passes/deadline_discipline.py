@@ -33,10 +33,10 @@ from typing import List
 from ray_tpu.devtools.analysis.core import FileContext, Finding, attr_tail
 
 PASS_ID = "deadline-discipline"
-VERSION = 5   # v5: serve plane (router/controller/proxy/replica)
+VERSION = 6   # v6: streaming data plane (ray_tpu/data/)
 
 _SCOPES = ("_private/", "collective/", "multislice/",
-           "serve/", "analysis_fixtures/")
+           "serve/", "data/", "analysis_fixtures/")
 
 _SUPPRESS_MARK = "no-deadline:"
 

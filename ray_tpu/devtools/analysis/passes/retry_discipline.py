@@ -29,13 +29,13 @@ from typing import List
 from ray_tpu.devtools.analysis.core import FileContext, Finding
 
 PASS_ID = "retry-discipline"
-VERSION = 6   # v6: serve plane (router/controller/proxy/replica)
+VERSION = 7   # v7: streaming data plane (ray_tpu/data/)
 
 # Enforced scopes: the runtime core, the collective/gang plane, plus
 # the lint fixture tree (the self-test floor in
 # tests/analysis_fixtures/).
 _SCOPES = ("_private/", "collective/", "multislice/",
-           "serve/", "analysis_fixtures/")
+           "serve/", "data/", "analysis_fixtures/")
 
 _SUPPRESS_MARK = "no-deadline:"
 

@@ -1,0 +1,50 @@
+"""Glue between the streaming data plane and the trainers
+(docs/data_pipeline.md §Trainer ingestion).
+
+The trainer's grad functions run on plain numpy (the multislice
+contract), while pipelines hand out numpy OR jax batches
+(``iter_batches`` / ``iter_jax_batches``). ``to_numpy_batch``
+normalizes either — jax CPU arrays convert zero-copy where the
+backing buffer allows. ``iter_train_batches`` is the one-call path
+from a Dataset to a prefetched numpy-batch iterator sized by the
+``data_prefetch_batches`` knob.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+
+def to_numpy_batch(batch: Any) -> Any:
+    """Normalize a batch's leaves to numpy arrays (dict batches
+    leaf-wise, bare arrays directly). Non-array leaves pass through."""
+    if isinstance(batch, dict):
+        out: Dict[str, Any] = {}
+        for k, v in batch.items():
+            try:
+                out[k] = np.asarray(v)
+            except Exception:
+                out[k] = v
+        return out
+    try:
+        return np.asarray(batch)
+    except Exception:
+        return batch
+
+
+def iter_train_batches(ds, *, batch_size: Optional[int] = 256,
+                       prefetch_batches: Optional[int] = None,
+                       drop_last: bool = False) -> Iterator[Any]:
+    """Numpy batches off a ``ray_tpu.data`` Dataset with prefetch —
+    the iterator ``MultiSliceTrainer.run_with_data`` consumes. The
+    prefetch depth defaults to the ``data_prefetch_batches`` knob."""
+    if prefetch_batches is None:
+        from ray_tpu.data.context import DataContext
+        prefetch_batches = DataContext.get_current().prefetch_batches
+    for batch in ds.iter_batches(batch_size=batch_size,
+                                 batch_format="numpy",
+                                 drop_last=drop_last,
+                                 prefetch_batches=prefetch_batches):
+        yield to_numpy_batch(batch)

@@ -39,6 +39,7 @@ import numpy as np
 import ray_tpu
 from ray_tpu import collective as col
 from ray_tpu.collective.collective import ReduceOp
+from ray_tpu.train.ingest import to_numpy_batch as _to_numpy_batch
 
 
 @dataclasses.dataclass
@@ -133,6 +134,28 @@ class _SliceTrainWorker:
         self.steps = int(step_idx)
         return int(step_idx), float(metric)
 
+    def train_step_data(self, step_idx, batch):
+        """Data-ingestion variant of :meth:`train_step` (docs/
+        data_pipeline.md §Trainer ingestion): the driver ships the
+        step's global batch ONCE as an object ref (every rank reads it
+        zero-copy from the store) and ``grad_fn`` receives it as a
+        fifth argument — ``grad_fn(state, rank, world, step, batch)``.
+        Same sync-then-apply contract: an aborted step leaves state
+        untouched and the driver re-drives it WITH THE SAME batch
+        (exactly-once batch consumption)."""
+        from ray_tpu.multislice import hierarchical_allreduce
+        _init, grad_fn, apply_fn = self._fns
+        m = self._meta
+        grad = np.asarray(grad_fn(self.state, m["global_rank"],
+                                  m["world_size"], step_idx, batch))
+        synced = hierarchical_allreduce(
+            grad, m["slice_group"], m.get("dcn_group"),
+            op=m.get("reduce_op", ReduceOp.MEAN))
+        self.state, metric = apply_fn(self.state, synced)
+        self.state = np.asarray(self.state)
+        self.steps = int(step_idx)
+        return int(step_idx), float(metric)
+
     def catch_up(self, to_step):
         """Recompute steps this rank missed, locally and without
         collectives (the peers have moved past them — a half-gang
@@ -156,6 +179,41 @@ class _SliceTrainWorker:
             for k in range(S):
                 grads = [np.asarray(grad_fn(self.state, k * R + i,
                                             m["world_size"], idx))
+                         for i in range(R)]
+                partials.append(op(np.stack(grads)))
+            synced = op(np.stack(partials)) if S > 1 else partials[0]
+            self.state, _ = apply_fn(self.state, synced)
+            self.state = np.asarray(self.state)
+            self.steps = idx
+        return self.steps
+
+    def catch_up_data(self, to_step, batches):
+        """Data-mode local catch-up: like :meth:`catch_up`, but the
+        per-step update needs the step's BATCH, which the driver
+        retains in a bounded cache (``keep_batches``) exactly for this
+        window. ``batches`` maps step index -> numpy batch (shipped
+        once as a ref). A step outside the window is unrecoverable
+        locally — surfaced with the remedy rather than computing a
+        wrong (batch-less) update."""
+        from ray_tpu.collective.collective import _REDUCERS
+        _init, grad_fn, apply_fn = self._fns
+        m = self._meta
+        op = _REDUCERS[m.get("reduce_op", ReduceOp.MEAN)]
+        S, R = m["num_slices"], m["ranks_per_slice"]
+        while self.steps < int(to_step):
+            idx = self.steps + 1
+            if idx not in batches:
+                raise RuntimeError(
+                    f"catch_up_data: the batch for step {idx} left "
+                    "the driver's keep_batches window; raise "
+                    "keep_batches (MultiSliceTrainer.run_with_data) "
+                    "above the checkpoint lag")
+            batch = batches[idx]
+            partials = []
+            for k in range(S):
+                grads = [np.asarray(grad_fn(self.state, k * R + i,
+                                            m["world_size"], idx,
+                                            batch))
                          for i in range(R)]
                 partials.append(op(np.stack(grads)))
             synced = op(np.stack(partials)) if S > 1 else partials[0]
@@ -313,7 +371,114 @@ class MultiSliceTrainer:
                 self.recover()
         return done
 
-    def recover(self) -> int:
+    def run_with_data(self, batches, num_steps: Optional[int] = None,
+                      *, keep_batches: int = 4,
+                      prefetch_batches: Optional[int] = None
+                      ) -> List[Tuple[int, float]]:
+        """Drive training from a batch iterator (a ``ray_tpu.data``
+        pipeline's ``iter_jax_batches``/``iter_batches``, or any
+        iterable of dict batches) with prefetch, whole-slice recovery,
+        and exactly-once batch consumption (docs/data_pipeline.md
+        §Trainer ingestion).
+
+        Each step draws ONE batch from the iterator, converts leaves
+        to numpy, ships it once via the object store, and calls
+        ``train_step_data`` on every rank (``grad_fn`` receives the
+        batch as its fifth argument; shard by ``global_rank`` inside
+        it for data parallelism). The last ``keep_batches`` batches
+        stay cached on the driver: a re-driven or caught-up step
+        reuses its ORIGINAL batch — a fault never drops a batch or
+        draws a fresh one for the same step index.
+
+        Starvation accounting: the fraction of wall time spent
+        waiting on the iterator lands in ``self.last_ingest`` and the
+        ``ray_tpu_data_trainer_starvation`` gauge — ≈ 0 means the
+        pipeline (with ``prefetch_batches`` buffered ahead) kept the
+        step loop compute-bound.
+
+        ``num_steps=None`` drains the iterator."""
+        import time as _time
+        from ray_tpu._private import data_stats
+        from ray_tpu._private.config import get_config
+        from ray_tpu.data._internal.prefetch import PrefetchIterator
+        from ray_tpu.exceptions import (ActorError, CollectiveAbortError,
+                                        GetTimeoutError,
+                                        WorkerCrashedError)
+        if prefetch_batches is None:
+            prefetch_batches = get_config().data_prefetch_batches
+        own_prefetch = (prefetch_batches and prefetch_batches > 0
+                        and not isinstance(batches, PrefetchIterator))
+        it = (PrefetchIterator(iter(batches), depth=prefetch_batches,
+                               name="rtpu-train-ingest")
+              if own_prefetch else iter(batches))
+        cache: Dict[int, Any] = {}      # step -> numpy batch (re-drive
+        # window; bounded to keep_batches entries below)
+        done: List[Tuple[int, float]] = []
+        target = (None if num_steps is None
+                  else self._next_step + num_steps)
+        retries_left = self.config.max_step_retries
+        wait_s = 0.0
+        t_start = _time.monotonic()
+        try:
+            while target is None or self._next_step < target:
+                idx = self._next_step + 1
+                batch = cache.get(idx)
+                if batch is None:
+                    t0 = _time.monotonic()
+                    try:
+                        raw = next(it)
+                    except StopIteration:
+                        break
+                    wait_s += _time.monotonic() - t0
+                    batch = _to_numpy_batch(raw)
+                    cache[idx] = batch
+                    for old in [k for k in cache
+                                if k <= idx - keep_batches]:
+                        cache.pop(old)
+                try:
+                    done.append(self._step_data(idx, batch))
+                    retries_left = self.config.max_step_retries
+                except (CollectiveAbortError, ActorError,
+                        GetTimeoutError, WorkerCrashedError):
+                    if retries_left == 0:
+                        raise
+                    retries_left -= 1
+                    self.recover(
+                        _catch_up=lambda resume:
+                        self._catch_up_data(resume, cache))
+        finally:
+            if own_prefetch:
+                it.close()
+            wall = _time.monotonic() - t_start
+            frac = (wait_s / wall) if wall > 0 else 0.0
+            self.last_ingest = {
+                "steps": len(done), "wait_s": wait_s, "wall_s": wall,
+                "starvation_fraction": frac}
+            data_stats.set_starvation(frac)
+        return done
+
+    def _step_data(self, idx: int, batch) -> Tuple[int, float]:
+        """One data-mode step on every rank; the batch ships once."""
+        ref = ray_tpu.put(batch)
+        refs = [h.train_step_data.remote(idx, ref)
+                for s in self.workers for h in s]
+        outs = ray_tpu.get(refs, timeout=self.config.step_timeout_s)
+        self._next_step = idx
+        self.history.append((idx, outs[0][1]))
+        return outs[0]
+
+    def _catch_up_data(self, resume: int, cache: Dict[int, Any]) -> None:
+        """Catch laggard ranks up using the driver's retained batches
+        (shipped once as a ref; every rank gets the call for
+        checkpoint-generation symmetry)."""
+        window = {k: v for k, v in cache.items() if k <= resume}
+        ref = ray_tpu.put(window)
+        ray_tpu.get(
+            [h.catch_up_data.remote(resume, ref)
+             for s in self.workers for h in s],
+            timeout=self.config.recover_timeout_s)
+
+    def recover(self, _catch_up=None) -> int:
         """Whole-slice recovery: wait for the dead slice's gang to
         re-form (PR-4 restart; its ranks restored the newest fully
         committed generation), re-join the DCN tier at the fenced
@@ -349,11 +514,15 @@ class MultiSliceTrainer:
             # shipped but generation K never two-phase committed): it
             # restored K-1 while the others hold K. Catch the laggards
             # up LOCALLY — every rank gets the call (symmetry); ranks
-            # already at `resume` no-op.
-            ray_tpu.get(
-                [h.catch_up.remote(resume)
-                 for s in self.workers for h in s],
-                timeout=cfg.recover_timeout_s)
+            # already at `resume` no-op. Data-mode recovery passes its
+            # own catch-up (the per-step update needs the batch).
+            if _catch_up is not None:
+                _catch_up(resume)
+            else:
+                ray_tpu.get(
+                    [h.catch_up.remote(resume)
+                     for s in self.workers for h in s],
+                    timeout=cfg.recover_timeout_s)
         self._next_step = resume
         self.history = [h for h in self.history if h[0] <= resume]
         return resume
