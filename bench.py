@@ -577,8 +577,19 @@ def bench_serve():
     size, shed fraction, and whether the queue gauge returned to
     baseline after the run.
 
-    (b) the PRE-BATCHING closed-loop HTTP ingress number retained for
-    continuity (worker-hosted proxy, 4 clients) as serve_http_*.
+    (b) HTTP ingress, same box same session, three numbers: the
+    legacy CLOSED-LOOP stdlib thread-per-request backend measured on
+    the WORKER-hosted proxy actor exactly as pre-async serve.start
+    shipped it (one connection per request, 4 clients — the pre-PR
+    shape, continuous with BENCH r05's recorded numbers) as
+    serve_http_legacy_*; OPEN-LOOP keep-alive pipelined load against
+    the async event-loop ingress on the driver (where it rides the
+    router's batched promise plane — paced arrivals on raw sockets,
+    latency measured from the SCHEDULED arrival so queueing under
+    overload is charged to the system, not hidden by a blocked
+    client) as serve_http_*; and streamed first-token latency
+    (client-observed + the ray_tpu_serve_first_token_ms window) as
+    serve_first_token_ms.
     """
     out = {}
     try:
@@ -666,20 +677,40 @@ def bench_serve():
         out["serve_queue_settled"] = settled
         serve.delete("Echo")
 
-        # ---- (b) legacy closed-loop HTTP ingress ----
+        # ---- (b) HTTP ingress: legacy vs async, same session ----
         import json as _json
+        import socket as _socket
         import urllib.request
+        from collections import deque as _deque
+
+        from ray_tpu.serve._private.http_proxy import HttpProxy
 
         @serve.deployment(num_replicas=2)
         class HttpEcho:
-            def __call__(self, payload):
-                return payload
+            @serve.batch(max_batch_size=256, batch_wait_timeout_ms=2)
+            async def __call__(self, items):
+                return items
 
-        serve.start(http=True, proxy_location="worker")
         serve.run(HttpEcho.bind())
-        host, port = serve.http_address()
-        url = f"http://{host}:{port}/HttpEcho"
+        controller = serve._controller
         body = _json.dumps({"v": 1}).encode()
+
+        # legacy closed-loop: the stdlib thread-per-request backend in
+        # a WORKER-hosted ProxyActor — the exact topology pre-async
+        # serve.start(http=True) brought up — with a fresh connection
+        # per request (what every pre-PR client did)
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.serve._private.http_proxy import ProxyActor
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        head = global_worker().node_group.head_node_id.hex()
+        legacy = ray_tpu.remote(ProxyActor).options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=head)).remote(backend="threaded")
+        ray_tpu.get(legacy.ping.remote(), timeout=60)
+        controller.register_proxy(legacy)
+        lhost, lport = ray_tpu.get(legacy.address.remote(), timeout=30)
+        url = f"http://{lhost}:{lport}/HttpEcho"
 
         def one():
             req = urllib.request.Request(
@@ -690,19 +721,8 @@ def bench_serve():
                 assert resp.status == 200
                 resp.read()
 
-        # wait for the proxy to learn the route, then warm
-        deadline = time.perf_counter() + 30
-        while True:
-            try:
-                one()
-                break
-            except Exception:
-                if time.perf_counter() > deadline:
-                    raise
-                time.sleep(0.2)
         for _ in range(20):
             one()
-
         n_threads, per = 4, 100
         hlats = []
         hlat_lock = threading.Lock()
@@ -724,9 +744,148 @@ def bench_serve():
         for t in threads:
             t.join()
         dt = time.perf_counter() - t0
-        out["serve_http_rps"] = round(n_threads * per / dt, 1)
-        out["serve_http_p99_ms"] = round(
+        out["serve_http_legacy_rps"] = round(n_threads * per / dt, 1)
+        out["serve_http_legacy_p99_ms"] = round(
             float(np.percentile(np.array(hlats), 99)) * 1e3, 2)
+        controller.detach_proxies()
+        ray_tpu.get(legacy.prepare_shutdown.remote(5.0), timeout=30)
+        ray_tpu.kill(legacy)
+
+        # open-loop keep-alive pipelined load on the async ingress:
+        # paced arrivals fanned over NCONN persistent connections;
+        # each request's latency runs from its SCHEDULED arrival to
+        # its response, so a backed-up server pays in p99 instead of
+        # silently slowing the client (open-loop honesty).
+        proxy = HttpProxy(controller, backend="async")
+        ahost, aport = proxy.address
+        REQ = (b"POST /HttpEcho HTTP/1.1\r\nHost: b\r\n"
+               b"Content-Type: application/json\r\n"
+               b"Content-Length: "
+               + str(len(body)).encode() + b"\r\n\r\n" + body)
+        HTTP_RPS, NCONN = 12500, 4
+        NH = 32000
+        H_TICK = 0.005
+        H_SAMPLE = 8        # stamp 1-in-8: the client shares this
+        #                     core with the server under test, so
+        #                     per-response bookkeeping shaves capacity
+        conns = []
+        for _ in range(NCONN):
+            s = _socket.create_connection((ahost, aport), timeout=60)
+            s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            conns.append(s)
+        # warm: one round-trip per connection; the echo response is
+        # byte-identical every time, so readers consume fixed-size
+        # blocks instead of parsing headers per response (client CPU
+        # shares this one core with the server under test)
+        for s in conns:
+            s.sendall(REQ)
+        resp_len = 0
+        files = [s.makefile("rb") for s in conns]
+        for f in files:
+            line = f.readline()
+            assert b"200" in line
+            total = len(line)
+            clen = 0
+            while True:
+                ln = f.readline()
+                total += len(ln)
+                if not ln.strip():
+                    break
+                if ln.lower().startswith(b"content-length"):
+                    clen = int(ln.split(b":")[1])
+            f.read(clen)
+            resp_len = total + clen
+        scheds = [_deque() for _ in range(NCONN)]
+        alats, alock = [], threading.Lock()
+        per_conn = NH // NCONN
+        t_end_box = [0.0]
+
+        def reader(i):
+            f, q, mine = files[i], scheds[i], []
+            for k in range(per_conn):
+                blob = f.read(resp_len)
+                assert len(blob) == resp_len
+                # sampled stamps carry their per-conn sequence number;
+                # the producer appends before sendall, so a stamp is
+                # always present before its response can arrive
+                if q and q[0][0] == k:
+                    mine.append(time.perf_counter() - q.popleft()[1])
+            with alock:
+                alats.extend(mine)
+                t_end_box[0] = max(t_end_box[0], time.perf_counter())
+
+        readers = [threading.Thread(target=reader, args=(i,))
+                   for i in range(NCONN)]
+        h_chunk = int(HTTP_RPS * H_TICK)
+        t_start = time.perf_counter()
+        for t in readers:
+            t.start()
+        next_tick = t_start
+        g = 0
+        seqs = [0] * NCONN
+        while g < NH:
+            k = min(h_chunk, NH - g)
+            counts = [0] * NCONN
+            for _ in range(k):
+                i = g % NCONN
+                if g % H_SAMPLE == 0:           # scheduled arrival
+                    scheds[i].append((seqs[i], next_tick))
+                seqs[i] += 1
+                counts[i] += 1
+                g += 1
+            for i in range(NCONN):
+                if counts[i]:
+                    conns[i].sendall(REQ * counts[i])
+            next_tick += H_TICK
+            delay = next_tick - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        for t in readers:
+            t.join(timeout=120)
+        arr = np.array(alats) * 1e3
+        out["serve_http_rps"] = round(
+            NH / (t_end_box[0] - t_start), 1)
+        out["serve_http_p99_ms"] = round(float(np.percentile(arr, 99)), 2)
+        out["serve_http_p50_ms"] = round(float(np.percentile(arr, 50)), 2)
+
+        # streamed first-token latency through the async ingress
+        @serve.deployment
+        class Tok:
+            def __call__(self, n):
+                for i in range(int(n)):
+                    yield {"t": i}
+
+        serve.run(Tok.bind(), name="Tok")
+        sreq = (b"POST /Tok?stream=1 HTTP/1.1\r\nHost: b\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: 1\r\n\r\n8")
+        ft = []
+        s = conns[0]
+        f = s.makefile("rb")
+        for _ in range(20):
+            t0 = time.perf_counter()
+            s.sendall(sreq)
+            f.readline()                        # status line
+            while f.readline().strip():
+                pass                            # headers
+            first_seen = False
+            while True:                         # chunks to terminator
+                size = int(f.readline().strip(), 16)
+                if size == 0:
+                    f.readline()
+                    break
+                if not first_seen:
+                    ft.append((time.perf_counter() - t0) * 1e3)
+                    first_seen = True
+                f.read(size)
+                f.readline()
+        out["serve_first_token_ms"] = round(
+            float(np.percentile(np.array(ft), 50)), 2)
+        out["serve_first_token_gauge_ms"] = round(
+            serve_stats.first_token_ms(), 2)
+        for s in conns:
+            s.close()
+        proxy.shutdown()
     except Exception as e:
         print(f"# serve bench failed: {e!r}", file=sys.stderr)
     finally:

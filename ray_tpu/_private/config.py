@@ -244,6 +244,29 @@ _D("serve_autoscale_ewma_alpha", float, 0.5,
    "Smoothing factor of the serve autoscaler's load EWMA (weight of "
    "the newest interval sample; 1.0 = instantaneous load, the "
    "pre-serve-plane behavior).")
+_D("serve_http_ingress", str, "async",
+   "HTTP ingress backend: 'async' (selector event loop — "
+   "non-blocking HTTP/1.1 with keep-alive and pipelining, requests "
+   "ride the router's promise-ref batched path, completion callbacks "
+   "write responses; docs/serve.md §Ingress) or 'threaded' (the "
+   "legacy stdlib thread-per-request server, kept for comparison "
+   "and as an escape hatch).")
+_D("serve_http_pipeline_max", int, 128,
+   "Per-connection cap on pipelined requests awaiting responses at "
+   "the async ingress. A connection at the cap stops being READ from "
+   "(natural TCP backpressure) until responses drain — the bound "
+   "that keeps per-connection ingress state finite.")
+_D("serve_http_write_buffer_bytes", int, 1 << 20,
+   "Per-connection outbound high-water mark at the async ingress: "
+   "past it, streaming item consumption pauses (and head-of-line "
+   "response flushing continues) until the client drains below it — "
+   "a slow reader backpressures its own stream instead of buffering "
+   "without bound.")
+_D("serve_http_request_timeout_s", float, 120.0,
+   "Async-ingress per-request deadline: a request whose response "
+   "has not started after this long answers 504 and releases its "
+   "promise ref (matches the legacy handler's blocking-get "
+   "timeout). 0 disables the sweep.")
 _D("serve_zero_copy_threshold_bytes", int, 65536,
    "Request arguments at or above this size (bytes/bytearray/"
    "ndarray) are put into the object store once at the handle and "

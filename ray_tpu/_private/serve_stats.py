@@ -15,6 +15,7 @@ from __future__ import annotations
 import threading
 import time
 import weakref
+from collections import deque
 
 _lock = threading.Lock()
 
@@ -25,7 +26,15 @@ _counters = {  # guarded-by: _lock
     "batches": 0,         # batched dispatches sent to replicas
     "batch_items": 0,     # requests carried by those dispatches
     "batch_retries": 0,   # whole-batch retries after a replica death
+    "streams": 0,         # streaming requests started at an ingress
+    "stream_items": 0,    # items written to streaming clients
+    "stream_errors": 0,   # streams ended by a TYPED terminal event
 }
+
+# First-token latency window (streaming requests: request parsed ->
+# first item on the wire). Bounded ring: the gauge reports the mean of
+# the most recent samples, old ones age out by displacement.
+_first_token_ms: deque = deque(maxlen=1024)  # guarded-by: _lock
 
 # Live ServeController instances (weak: a shut-down controller must
 # not be kept alive by the metrics plane).
@@ -51,6 +60,21 @@ def controllers() -> list:
 def snapshot() -> dict:
     with _lock:
         return dict(_counters)
+
+
+def observe_first_token(ms: float) -> None:
+    """Record one streaming request's first-token latency (ms)."""
+    with _lock:
+        _first_token_ms.append(float(ms))
+
+
+def first_token_ms() -> float:
+    """Mean first-token latency over the recent sample window (the
+    ``ray_tpu_serve_first_token_ms`` gauge; 0.0 = no streamed load)."""
+    with _lock:
+        if not _first_token_ms:
+            return 0.0
+        return sum(_first_token_ms) / len(_first_token_ms)
 
 
 def batch_avg() -> float:
@@ -81,3 +105,4 @@ def reset() -> None:
         for k in _counters:
             _counters[k] = 0
         _rps_prev["t"], _rps_prev["n"] = None, 0
+        _first_token_ms.clear()

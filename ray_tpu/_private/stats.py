@@ -110,6 +110,11 @@ def install_runtime_metrics() -> None:
         "ray_tpu_serve_replicas",
         "Live replicas per deployment (autoscaler-visible)",
         tag_keys=("deployment",))
+    serve_first_token = m.Gauge(
+        "ray_tpu_serve_first_token_ms",
+        "Streaming serve requests: mean time from request parse to "
+        "the first item on the wire, over the recent sample window "
+        "(docs/serve.md §Ingress; 0 = no streamed load)")
     data_queued = m.Gauge(
         "ray_tpu_data_queued_bytes",
         "Streaming data plane: bytes parked at each live pipeline "
@@ -246,6 +251,7 @@ def install_runtime_metrics() -> None:
         # deployment, realized batch coalescing factor
         serve_rps.set(serve_stats.rps_sample())
         serve_batch.set(serve_stats.batch_avg())
+        serve_first_token.set(serve_stats.first_token_ms())
         serve_queue.clear()      # deleted deployments' series vanish
         serve_replicas.clear()
         for controller in serve_stats.controllers():

@@ -645,6 +645,24 @@ class Worker:
                 return
         callback(oid)
 
+    def discard_object_ready(self, oid: ObjectID, callback) -> None:
+        """Withdraw a pending ``on_object_ready`` registration (no-op
+        if it already fired or was never made). Lets a caller that
+        races readiness against another signal — e.g. the HTTP
+        ingress waiting on a stream item OR the generator's done
+        marker — drop the loser's hook instead of leaking it for an
+        object that will never be produced."""
+        with self._ready_cb_lock:
+            cbs = self._ready_callbacks.get(oid)
+            if not cbs:
+                return
+            try:
+                cbs.remove(callback)
+            except ValueError:
+                return
+            if not cbs:
+                del self._ready_callbacks[oid]
+
     def _on_ref_zero(self, oid: ObjectID) -> None:
         # Pop-and-inspect: inline (blob/err) entries — the common case
         # for small task results — have no shm segment and no device

@@ -4,11 +4,14 @@ Reference: ``python/ray/serve/_private/proxy.py`` (uvicorn/starlette
 proxy actors, streaming responses over chunked transfer) [UNVERIFIED —
 mount empty, SURVEY.md §0].
 
-Two placements share one handler:
+Two placements share one server backend (the ``serve_http_ingress``
+knob picks it: ``async`` — the event-loop ingress in ``ingress.py``,
+the default — or ``threaded`` — the stdlib thread-per-request server
+defined here, kept for comparison benchmarks and as an escape hatch):
 
-- ``HttpProxy``: a threaded stdlib server in the driver process —
-  zero-setup ingress for tests and notebooks.
-- ``ProxyActor``: the same server hosted in a WORKER process (the
+- ``HttpProxy``: ingress in the driver process — zero-setup for tests
+  and notebooks.
+- ``ProxyActor``: the same ingress hosted in a WORKER process (the
   reference's proxy-actor topology): HTTP parsing/serialization runs
   off the driver's threads, and the controller pushes route-table
   updates to it as replica membership changes.
@@ -84,7 +87,11 @@ def _make_handler(get_replica_set: Callable[[str], Optional[object]],
     """One handler class over any route-table source (controller in the
     driver, pushed table in a proxy worker)."""
     import ray_tpu
-    from ray_tpu.exceptions import BackpressureError
+    from ray_tpu._private import serve_stats
+    from ray_tpu.serve._private.ingress import (
+        classify_error,
+        terminal_record,
+    )
 
     class _Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -119,19 +126,18 @@ def _make_handler(get_replica_set: Callable[[str], Optional[object]],
                 return True
             return "text/event-stream" in self.headers.get("Accept", "")
 
-        def _send_503(self, e: BackpressureError) -> None:
-            """Shed: the deployment's queue is at max_queued_requests.
-            Retry-After carries the router's backoff hint so clients
-            space their retries (docs/serve.md §Backpressure)."""
-            blob = json.dumps({
-                "error": "backpressure",
-                "retryable": bool(getattr(e, "retryable", True)),
-                "detail": str(e)[:500],
-            }).encode()
-            self.send_response(503)
-            retry_after = max(1, int(round(
-                getattr(e, "backoff_s", 0.0) or 1.0)))
-            self.send_header("Retry-After", str(retry_after))
+        def _send_typed_error(self, e: Exception) -> None:
+            """Typed error mapping, shared with the async ingress
+            (docs/serve.md §Ingress): overload → 503 + Retry-After
+            (router backoff hint), replica/worker death → 502, other
+            exceptions → 500 — every branch names the taxonomy class
+            in ``X-RTPU-Error-Type`` instead of erasing it into an
+            anonymous ``send_error(500)``."""
+            status, reason, extra, body = classify_error(e)
+            blob = json.dumps(body).encode()
+            self.send_response(status, reason)
+            for k, v in extra:
+                self.send_header(k, v)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(blob)))
             self.end_headers()
@@ -159,11 +165,8 @@ def _make_handler(get_replica_set: Callable[[str], Optional[object]],
                     return
                 ref = replica_set.assign("__call__", args, {})
                 result = ray_tpu.get(ref, timeout=120)
-            except BackpressureError as e:
-                self._send_503(e)
-                return
-            except Exception as e:  # noqa: BLE001 - surfaces as 500
-                self.send_error(500, str(e)[:500])
+            except Exception as e:  # noqa: BLE001 - typed mapping
+                self._send_typed_error(e)
                 return
             blob = json.dumps(result, default=str).encode()
             self.send_response(200)
@@ -175,10 +178,19 @@ def _make_handler(get_replica_set: Callable[[str], Optional[object]],
         def _stream_response(self, replica_set, args) -> None:
             """Chunked transfer: one JSON line per streamed item,
             flushed as the replica yields it — the client reads items
-            before the producer finishes."""
+            before the producer finishes. A mid-stream failure (user
+            exception, replica death) ends the stream with a TYPED
+            terminal record — ``error_type`` carries the taxonomy
+            class, ``terminal: true`` marks it unambiguous — then the
+            chunked terminator, and the connection closes so the
+            client never mistakes truncation for success."""
             gen = replica_set.assign("__call__", args, {}, stream=True)
+            serve_stats.incr("streams")
+            sse = "text/event-stream" in self.headers.get("Accept", "")
             self.send_response(200)
-            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Content-Type",
+                             "text/event-stream" if sse
+                             else "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
 
@@ -187,15 +199,35 @@ def _make_handler(get_replica_set: Callable[[str], Optional[object]],
                                  + blob + b"\r\n")
                 self.wfile.flush()
 
+            t0, n = time.monotonic(), 0
             try:
-                for ref in gen:
-                    item = ray_tpu.get(ref, timeout=120)
-                    chunk(json.dumps(item, default=str).encode() + b"\n")
-            except Exception as e:  # noqa: BLE001 - mid-stream failure
-                chunk(json.dumps({"error": str(e)[:500]}).encode()
-                      + b"\n")
-            self.wfile.write(b"0\r\n\r\n")
-            self.wfile.flush()
+                try:
+                    for ref in gen:
+                        item = ray_tpu.get(ref, timeout=120)
+                        n += 1
+                        if n == 1:
+                            serve_stats.observe_first_token(
+                                (time.monotonic() - t0) * 1e3)
+                        serve_stats.incr("stream_items")
+                        blob = json.dumps(item, default=str).encode()
+                        if sse:
+                            chunk(b"data: " + blob + b"\n\n")
+                        else:
+                            chunk(blob + b"\n")
+                except Exception as e:  # noqa: BLE001 - typed terminal
+                    serve_stats.incr("stream_errors")
+                    blob = json.dumps(terminal_record(e)).encode()
+                    if sse:
+                        chunk(b"event: error\ndata: " + blob + b"\n\n")
+                    else:
+                        chunk(blob + b"\n")
+                    self.close_connection = True
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except OSError:
+                # client went away mid-stream: drop the generator (its
+                # remaining refs release with it) and end the handler
+                self.close_connection = True
 
         def _do_get_inner(self):
             if self.path.rstrip("/") in ("", "/-", "/-/routes"):
@@ -211,19 +243,43 @@ def _make_handler(get_replica_set: Callable[[str], Optional[object]],
     return _Handler
 
 
+def _resolve_backend(backend: Optional[str]) -> str:
+    """``async`` (event-loop ingress, the default) or ``threaded``
+    (stdlib thread-per-request, kept for comparison benchmarks and as
+    an escape hatch via the ``serve_http_ingress`` knob)."""
+    if backend is None:
+        from ray_tpu._private.config import get_config
+        backend = get_config().serve_http_ingress
+    if backend not in ("async", "threaded"):
+        raise ValueError(
+            f"serve_http_ingress must be 'async' or 'threaded', "
+            f"got {backend!r}")
+    return backend
+
+
 class HttpProxy:
     """In-driver ingress (tests/notebooks)."""
 
-    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0,
+                 backend: Optional[str] = None):
         self._controller = controller
-        handler = _make_handler(controller.get_replica_set,
-                                controller.status)
-        self._server = _CountingHTTPServer((host, port), handler)
-        self.address = self._server.server_address
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, kwargs={"poll_interval": 0.1},
-            daemon=True, name="rtpu-serve-http")
-        self._thread.start()
+        self._thread = None
+        if _resolve_backend(backend) == "async":
+            from ray_tpu.serve._private.ingress import AsyncIngress
+            self._server = AsyncIngress(controller.get_replica_set,
+                                        controller.status,
+                                        host=host, port=port)
+            self.address = self._server.address
+        else:
+            handler = _make_handler(controller.get_replica_set,
+                                    controller.status)
+            self._server = _CountingHTTPServer((host, port), handler)
+            self.address = self._server.server_address
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                daemon=True, name="rtpu-serve-http")
+            self._thread.start()
 
     def shutdown(self, drain_timeout_s: float = 10.0) -> None:
         """Deterministic teardown: stop accepting, join the listener
@@ -236,7 +292,8 @@ class HttpProxy:
                 logger.warning(
                     "http proxy closed with %d requests still in "
                     "flight after %.0fs drain", left, drain_timeout_s)
-            self._thread.join(timeout=5)
+            if self._thread is not None:
+                self._thread.join(timeout=5)
             self._server.server_close()
         except Exception:
             pass    # double-shutdown / already-closed socket
@@ -250,17 +307,26 @@ class ProxyActor:
     changes (the pushed ReplicaSet pickles as a snapshot with fresh
     local in-flight counts)."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 backend: Optional[str] = None):
         self._routes = {}            # name -> ReplicaSet snapshot
         self._lock = threading.Lock()
-        handler = _make_handler(self._get_replica_set, self._status)
-        self._server = _CountingHTTPServer((host, port), handler)
-        self._addr = self._server.server_address
-        self._thread = threading.Thread(
-            target=self._server.serve_forever,
-            kwargs={"poll_interval": 0.1},
-            daemon=True, name="rtpu-serve-http-worker")
-        self._thread.start()
+        self._thread = None
+        if _resolve_backend(backend) == "async":
+            from ray_tpu.serve._private.ingress import AsyncIngress
+            self._server = AsyncIngress(self._get_replica_set,
+                                        self._status,
+                                        host=host, port=port)
+            self._addr = self._server.address
+        else:
+            handler = _make_handler(self._get_replica_set, self._status)
+            self._server = _CountingHTTPServer((host, port), handler)
+            self._addr = self._server.server_address
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                daemon=True, name="rtpu-serve-http-worker")
+            self._thread.start()
 
     def _get_replica_set(self, name: str):
         with self._lock:

@@ -265,15 +265,28 @@ class ReplicaSet:
 
     # -- assignment (direct path) --------------------------------------
 
-    def begin(self, model_id: Optional[str] = None):
+    def begin(self, model_id: Optional[str] = None,
+              nowait: bool = False):
         """Pick a replica (pow-2 / sticky-model) and charge one
         in-flight request to it. Returns the replica handle; the caller
         MUST balance with ``end(id(handle))`` when the request
-        resolves (``assign`` wires this automatically)."""
+        resolves (``assign`` wires this automatically).
+
+        ``nowait=True`` (the async HTTP ingress): instead of parking
+        the calling thread when every candidate is at its
+        ``max_ongoing_requests`` cap (or membership is momentarily
+        empty mid-rollout), raise a retryable ``BackpressureError`` —
+        the event loop maps it to 503 + Retry-After and stays
+        non-blocking."""
         deadline = None
         with self._lock:
             while True:
                 if not self._replicas:
+                    if nowait:
+                        raise BackpressureError(
+                            f"deployment {self.deployment_name!r} has "
+                            "no live replicas (mid-rollout?)",
+                            retryable=True, backoff_s=0.5)
                     raise RuntimeError(
                         f"deployment {self.deployment_name!r} has no "
                         "live replicas")
@@ -295,6 +308,11 @@ class ReplicaSet:
                             pinned_full = True
                             chosen = None
                 if not pool or pinned_full:
+                    if nowait:
+                        raise BackpressureError(
+                            f"deployment {self.deployment_name!r}: "
+                            f"all replicas at max_ongoing_requests="
+                            f"{cap}", retryable=True, backoff_s=0.25)
                     # every candidate at its cap: wait for a release
                     if deadline is None:
                         deadline = (time.monotonic()
@@ -349,19 +367,21 @@ class ReplicaSet:
             self._dispatch_cv.notify_all()
 
     def assign(self, method: str, args: tuple, kwargs: dict,
-               model_id: Optional[str] = None, stream: bool = False):
+               model_id: Optional[str] = None, stream: bool = False,
+               nowait: bool = False):
         """Route one request. ``stream=True`` calls the replica's
         streaming endpoint and returns an ObjectRefGenerator whose
         items land as the replica yields them. May raise
         ``BackpressureError`` (retryable) when the deployment's queue
-        bound is hit."""
+        bound is hit — always with ``nowait=True`` (event-loop
+        callers), which sheds instead of parking in admission."""
         self._check_shed()
         serve_stats.incr("requests")
         bcfg = self.batch_cfg.get(method)
         if (bcfg is not None and not stream and self._driver_side
                 and len(args) == 1 and not kwargs):
             return self._assign_batched(method, args[0], model_id, bcfg)
-        chosen = self.begin(model_id)
+        chosen = self.begin(model_id, nowait=nowait)
         if stream:
             gen = chosen.handle_request_streaming.options(
                 num_returns="streaming").remote(method, args, kwargs,
@@ -400,6 +420,28 @@ class ReplicaSet:
             ref.future().add_done_callback(_done)
 
     # -- batched dispatch plane (driver-side) --------------------------
+
+    def assign_promised(self, method: str, value,
+                        model_id: Optional[str] = None):
+        """The async HTTP ingress's dispatch: ALWAYS reserve a promise
+        ObjectRef and park the request on the batched plane — even for
+        methods without ``@serve.batch`` (``handle_request_batch``
+        isolates per-item user errors, and the default gather knobs
+        apply), so ingress traffic rides the gather layers and the
+        event loop never blocks in admission. Returns the promise ref
+        immediately; raises ``BackpressureError`` on shed. In a
+        non-driver process (worker-hosted proxy) there is no promise
+        plane: falls back to a non-blocking direct dispatch."""
+        self._check_shed()
+        serve_stats.incr("requests")
+        bcfg = self.batch_cfg.get(method) or {}
+        if not self._driver_side:
+            chosen = self.begin(model_id, nowait=True)
+            ref = chosen.handle_request.remote(method, (value,), {},
+                                               model_id)
+            self._watch(ref, id(chosen))
+            return ref
+        return self._assign_batched(method, value, model_id, bcfg)
 
     def _assign_batched(self, method: str, value, model_id, bcfg):
         """Reserve a promise ref, park the request in its gather
