@@ -283,3 +283,33 @@ def install_runtime_metrics() -> None:
         data_starvation.set(data_stats.starvation())
 
     m.register_collector(collect)
+
+
+def node_reporter_gauges():
+    """The per-node reporter-agent series (resource ledger totals/
+    availability, raylet heartbeat stats, per-worker RSS). Declared
+    here — not at the collector in worker.py — so every ``ray_tpu_*``
+    constructor lives in a stats module, where the metric-discipline
+    pass audits names, label keys, and the docs registry. The caller
+    (``Worker._install_node_metrics``) owns the refresh collector.
+
+    Returns ``(available, total, stat, rss)`` gauges.
+    """
+    avail_g = m.Gauge(
+        "ray_tpu_node_resource_available",
+        "Per-node available resource units",
+        tag_keys=("node", "resource"))
+    total_g = m.Gauge(
+        "ray_tpu_node_resource_total",
+        "Per-node total resource units",
+        tag_keys=("node", "resource"))
+    stat_g = m.Gauge(
+        "ray_tpu_node_stat",
+        "Per-node raylet stats (queued/running tasks, actors, "
+        "store bytes/objects, workers, pulls)",
+        tag_keys=("node", "stat"))
+    rss_g = m.Gauge(
+        "ray_tpu_worker_rss_bytes",
+        "Per-worker resident set size (reporter-agent role)",
+        tag_keys=("node", "worker"))
+    return avail_g, total_g, stat_g, rss_g

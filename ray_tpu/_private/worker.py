@@ -541,23 +541,8 @@ class Worker:
         the scheduler ledger + raylet heartbeat stats, refreshed at
         scrape time via a registry collector."""
         from ray_tpu.util import metrics
-        avail_g = metrics.Gauge(
-            "ray_tpu_node_resource_available",
-            "Per-node available resource units",
-            tag_keys=("node", "resource"))
-        total_g = metrics.Gauge(
-            "ray_tpu_node_resource_total",
-            "Per-node total resource units",
-            tag_keys=("node", "resource"))
-        stat_g = metrics.Gauge(
-            "ray_tpu_node_stat",
-            "Per-node raylet stats (queued/running tasks, actors, "
-            "store bytes/objects, workers, pulls)",
-            tag_keys=("node", "stat"))
-        rss_g = metrics.Gauge(
-            "ray_tpu_worker_rss_bytes",
-            "Per-worker resident set size (reporter-agent role)",
-            tag_keys=("node", "worker"))
+        from ray_tpu._private.stats import node_reporter_gauges
+        avail_g, total_g, stat_g, rss_g = node_reporter_gauges()
 
         def collect():
             if self._shutdown:
@@ -2508,8 +2493,11 @@ class Worker:
                 # so the kill reaches the worker, not just the tables.
                 self._ensure_actor_route(actor_id, info)
             except Exception:
-                pass    # hosting raylet unreachable: state update
-                        # below still marks the actor dead
+                # swallow-ok: kill is best-effort delivery — the
+                # hosting raylet may be unreachable (ActorError /
+                # ConnectionError); the tombstone + DEAD state update
+                # below are the authoritative kill either way
+                pass
         with self._actor_lock:
             self._actor_restarts[actor_id] = 0
             # Tombstone: a creation spec a concurrent _on_actor_death

@@ -12,6 +12,11 @@ full summary cache, so a cross-file finding caused by your edit is
 caught even when its anchor file is untouched. ``--timings`` prints
 per-pass wall clock. Full-suite runs prune stale baseline entries
 (reported, then removed) so the suppression file cannot silently rot.
+
+``--ci`` is the one-flag CI entry point: the enforced full-tree
+invocation plus ``--timings``, nonzero exit on any unsuppressed
+finding. With a warm cache it stays well under the tier-1 bound
+(``test_ci_mode_aggregates``).
 """
 
 from __future__ import annotations
@@ -112,7 +117,23 @@ def main(argv=None) -> int:
                         help="write the graftsan contract manifest "
                              "(devtools/analysis/contracts.json) from "
                              "the phase-1 summaries and exit")
+    parser.add_argument("--ci", action="store_true",
+                        help="CI aggregate mode: scan the full "
+                             "ray_tpu tree, print per-pass timings, "
+                             "exit nonzero on any unsuppressed "
+                             "finding")
     args = parser.parse_args(argv)
+
+    if args.ci:
+        # one-flag CI entry point: the enforced full-tree invocation
+        # with timings, no paths to get wrong
+        if args.paths or args.changed or args.pass_ids \
+                or args.update_baseline:
+            print("error: --ci is the full-tree aggregate mode; it "
+                  "takes no paths and combines with no scan-shaping "
+                  "flags", file=sys.stderr)
+            return 2
+        args.timings = True
 
     if args.emit_contracts:
         from ray_tpu.devtools.analysis import contracts

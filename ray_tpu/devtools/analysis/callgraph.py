@@ -54,7 +54,7 @@ from ray_tpu.devtools.analysis.core import (FileContext, attr_tail,
 
 # Bump to invalidate every cached summary (core folds this into the
 # cache version tag alongside the per-pass versions).
-SUMMARY_VERSION = 2
+SUMMARY_VERSION = 3
 
 # A with-item / lock-arg is considered lock-like when its defining
 # class marks it as a lock, or (fallback for files whose __init__ was
@@ -77,6 +77,26 @@ _MODULE_FIELD_RE = re.compile(r"^(\w+)\s*[:=\[]")
 
 _CHAOS_METHODS = {"fire", "fire_arg"}
 
+_CHAOS_UNREACHABLE_MARK = "chaos-unreachable:"
+_SWALLOW_OK_MARK = "swallow-ok:"
+
+# Metric declarations/uses: constructor calls of the util.metrics
+# family whose first argument is a string literal. These are the only
+# places a `ray_tpu_*` series name is load-bearing in code — scrape
+# emission always goes through the constructed objects.
+_METRIC_CTORS = {"Gauge", "Counter", "Histogram"}
+
+# The ingress HTTP error table literal (error-flow pass): a
+# module-level `{<taxonomy class name>: <status int>}` assignment
+# under this name is the machine-checked boundary mapping.
+_HTTP_TABLE_NAME = "_HTTP_STATUS_BY_TAXONOMY"
+
+# Exception-class summary filter: record structure only for classes
+# that look like exception taxonomy members (name or a base mentions
+# Error/Exception) — everything the error-flow pass can ever care
+# about, without bloating every file's summary.
+_EXCISH_RE = re.compile(r"(Error|Exception)$")
+
 _BLOCKING_OK_MARK = "blocking-ok:"
 _WIRE_OK_MARK = "wire-shape-ok:"
 _LOCK_ORDER_OK_MARK = "lock-order-ok:"
@@ -88,6 +108,24 @@ def _literal_str(node: ast.AST) -> Optional[str]:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return node.value
     return None
+
+
+def _str_shape(node: Optional[ast.AST]) -> str:
+    """Best static rendering of a string-valued chaos-event argument:
+    a literal gives itself, an f-string gives its leading constant
+    prefix + ``*`` (``f"save_{tag}"`` -> ``save_*``), anything else
+    (or a missing arg) is fully dynamic."""
+    if node is None:
+        return ""
+    lit = _literal_str(node)
+    if lit is not None:
+        return lit
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        prefix = _literal_str(first)
+        if prefix:
+            return prefix + "*"
+    return "*"
 
 
 def _root_name(node: ast.AST) -> Optional[str]:
@@ -599,7 +637,12 @@ def summarize_file(ctx: FileContext) -> dict:
 
     # chaos hook sites (`chaos.fire(component, point, ...)`) — the
     # manifest records them so a sanitized chaos run can report which
-    # fault points the enforcement actually covered.
+    # fault points the enforcement actually covered, and the
+    # chaos-coverage pass matches them against docs/tests. Entries:
+    # [line, method, component, point, detail, unreachable_ok] where
+    # component/detail degrade to "*" when dynamic (rpc.py's
+    # `chaos.fire(component, "send", _frame_method(obj))`) and detail
+    # keeps an f-string's constant prefix (`save_*`).
     chaos_points: List[list] = []
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call):
@@ -611,12 +654,138 @@ def summarize_file(ctx: FileContext) -> dict:
         recv = attr_tail(fn.value)
         if recv is None or "chaos" not in recv.lower():
             continue
-        component = _literal_str(node.args[0]) if node.args else None
         point = _literal_str(node.args[1]) if len(node.args) > 1 \
             else None
-        if component is not None and point is not None:
-            chaos_points.append([node.lineno, fn.attr, component,
-                                 point])
+        if point is None:
+            continue
+        component = (_literal_str(node.args[0]) or "*") if node.args \
+            else "*"
+        detail = _str_shape(node.args[2]) if len(node.args) > 2 else ""
+        ok = suppressed_by_mark(ctx, node, _CHAOS_UNREACHABLE_MARK)
+        chaos_points.append([node.lineno, fn.attr, component, point,
+                             detail, ok])
+
+    # metric constructor sites (`Gauge("ray_tpu_x", ..., tag_keys=...)`)
+    # — the metric-discipline pass checks declaration locality, label
+    # consistency, and the both-direction docs-table contract from
+    # these. tag_keys: list of label names, or None when the keyword
+    # is present but not a literal tuple/list of strings.
+    metric_decls: List[list] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        ctor = attr_tail(node.func)
+        if ctor not in _METRIC_CTORS:
+            continue
+        name = _literal_str(node.args[0])
+        if name is None or not name.startswith("ray_tpu_"):
+            continue
+        tag_keys: Optional[List[str]] = []
+        for kw in node.keywords:
+            if kw.arg != "tag_keys":
+                continue
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                keys = [_literal_str(e) for e in kw.value.elts]
+                tag_keys = keys if all(k is not None for k in keys) \
+                    else None
+            else:
+                tag_keys = None
+        metric_decls.append([node.lineno, ctor, name, tag_keys,
+                             scope_at(node.lineno)])
+
+    # taxonomy raise sites + broad-except handlers (error-flow pass)
+    raises: List[list] = []
+    excepts: List[list] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Raise) and node.exc is not None:
+            exc = node.exc
+            name = attr_tail(exc.func) if isinstance(exc, ast.Call) \
+                else attr_tail(exc)
+            if name is not None:
+                raises.append([node.lineno, name,
+                               scope_at(node.lineno)])
+        elif isinstance(node, ast.Try):
+            try_start = node.body[0].lineno
+            try_end = max(getattr(stmt, "end_lineno", stmt.lineno)
+                          for stmt in node.body)
+            for handler in node.handlers:
+                names: List[str] = []
+                t = handler.type
+                if t is None:
+                    names = ["*"]
+                elif isinstance(t, ast.Tuple):
+                    names = [attr_tail(e) or "?" for e in t.elts]
+                else:
+                    names = [attr_tail(t) or "?"]
+                broad = any(n in ("*", "Exception", "BaseException")
+                            for n in names)
+                reraises = any(isinstance(n, ast.Raise)
+                               for stmt in handler.body
+                               for n in ast.walk(stmt))
+                ok = suppressed_by_mark(ctx, handler, _SWALLOW_OK_MARK)
+                excepts.append([handler.lineno, try_start, try_end,
+                                broad, names, reraises, ok,
+                                scope_at(handler.lineno)])
+
+    # exception-class structure (error-flow pass): bases, whether the
+    # class defines __init__/__reduce__, which self fields its
+    # __init__ assigns, and whether it chains to super().__init__.
+    exc_classes: Dict[str, dict] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        base_names = [attr_tail(b) or "?" for b in node.bases]
+        if not (_EXCISH_RE.search(node.name)
+                or any(_EXCISH_RE.search(b) for b in base_names)):
+            continue
+        has_init = has_reduce = calls_super_init = False
+        init_sets: List[str] = []
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__reduce__":
+                has_reduce = True
+            if item.name != "__init__":
+                continue
+            has_init = True
+            for sub in ast.walk(item):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            init_sets.append(t.attr)
+                elif (isinstance(sub, ast.Call)
+                      and isinstance(sub.func, ast.Attribute)
+                      and sub.func.attr == "__init__"
+                      and isinstance(sub.func.value, ast.Call)
+                      and attr_tail(sub.func.value.func) == "super"):
+                    calls_super_init = True
+        exc_classes[node.name] = {
+            "line": node.lineno,
+            "bases": base_names,
+            "has_init": has_init,
+            "has_reduce": has_reduce,
+            "init_sets": sorted(set(init_sets)),
+            "calls_super_init": calls_super_init,
+        }
+
+    # the ingress HTTP error table literal (error-flow pass)
+    http_table: Optional[dict] = None
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.Assign) \
+                or not isinstance(node.value, ast.Dict):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id == _HTTP_TABLE_NAME:
+                entries: Dict[str, int] = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    key = _literal_str(k) if k is not None else None
+                    if key is not None and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, int):
+                        entries[key] = v.value
+                http_table = {"line": node.lineno, "map": entries}
 
     # RPC surface (phase-2 rpc-surface pass links these project-wide)
     rpc_regs: List[list] = []
@@ -710,6 +879,11 @@ def summarize_file(ctx: FileContext) -> dict:
         "rpc_regs": rpc_regs,
         "rpc_calls": rpc_calls,
         "fastframe_safe": fastframe,
+        "metric_decls": metric_decls,
+        "raises": raises,
+        "excepts": excepts,
+        "exc_classes": exc_classes,
+        "http_table": http_table,
     }
 
 
@@ -763,8 +937,13 @@ class ProjectGraph:
     passes (each invocation builds one graph, passes reuse its memoized
     closures)."""
 
-    def __init__(self, summaries: Dict[str, dict]):
+    def __init__(self, summaries: Dict[str, dict],
+                 root: Optional[str] = None):
         self.summaries = summaries
+        # repo root for passes that must read non-Python surfaces
+        # (docs tables, test literals); None when the caller runs on
+        # detached fixture files.
+        self.root = root
         self.by_name: Dict[str, List[FuncInfo]] = {}
         self.by_cls_name: Dict[Tuple[str, str], List[FuncInfo]] = {}
         self.by_key: Dict[str, FuncInfo] = {}
@@ -1048,5 +1227,6 @@ class ProjectGraph:
         return out
 
 
-def build_graph(summaries: Dict[str, dict]) -> ProjectGraph:
-    return ProjectGraph(summaries)
+def build_graph(summaries: Dict[str, dict],
+                root: Optional[str] = None) -> ProjectGraph:
+    return ProjectGraph(summaries, root=root)

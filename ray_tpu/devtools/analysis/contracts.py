@@ -140,12 +140,17 @@ def emit_contracts(paths: Optional[Sequence[str]] = None,
                                      "end": end})
         for line in s.get("unbounded_ok_sites", []):
             unbounded_escapes.append({"path": path, "line": line})
-        for line, method, component, point in sorted(
+        for line, method, component, point, detail, ok in sorted(
                 s.get("chaos_points", [])):
-            chaos_points.append({"path": path, "line": line,
-                                 "method": method,
-                                 "component": component,
-                                 "point": point})
+            entry = {"path": path, "line": line,
+                     "method": method,
+                     "component": component,
+                     "point": point}
+            if detail:
+                entry["detail"] = detail
+            if ok:
+                entry["unreachable"] = True
+            chaos_points.append(entry)
 
     orders = []
     for path, line, nodes, elements in sorted(graph.declarations()):

@@ -6,10 +6,12 @@ The runtime under ``ray_tpu/_private`` is a layered concurrent system
 every class of advisor finding so far — unlocked mutations, state
 recorded before an RPC outcome is known, client/server RPC drift,
 lock-order inversions, blocking work under a lock, tuple-only gates on
-fastframe-normalized values — is statically detectable. This framework
-turns those one-off catches into a permanent ratchet: twelve passes
-(see ``passes/``) run over the tree, unsuppressed findings fail the
-build (tier-1 runs the suite via ``tests/test_static_analysis.py``).
+fastframe-normalized values, taxonomy errors that cannot survive a
+pickled reply boundary, rogue metric declarations, untested chaos
+points — is statically detectable. This framework turns those one-off
+catches into a permanent ratchet: sixteen passes (see ``passes/``)
+run over the tree, unsuppressed findings fail the build (tier-1 runs
+the suite via ``tests/test_static_analysis.py``).
 
 Execution is two-phase (graftcheck v2):
 
@@ -476,7 +478,7 @@ def run_analysis(paths: Sequence[str],
 
     # Phase 2: link and run the whole-program passes.
     graph = timed("parse+summarize",
-                  lambda: callgraph.build_graph(summaries))
+                  lambda: callgraph.build_graph(summaries, root=root))
     for p in graph_passes:
         timed(p.PASS_ID,
               lambda p=p: findings.extend(p.check_graph(graph)))

@@ -10,10 +10,13 @@ def load_passes() -> List:
         async_blocking,
         blocking_under_lock,
         bounded_queue,
+        chaos_coverage,
         deadline_discipline,
         durable_write,
+        error_flow,
         lock_discipline,
         lock_order,
+        metric_discipline,
         ref_leak,
         retry_discipline,
         rpc_surface,
@@ -25,4 +28,5 @@ def load_passes() -> List:
             silent_exception, ref_leak, retry_discipline,
             bounded_queue, deadline_discipline, durable_write,
             lock_order, blocking_under_lock, wire_shape,
-            sanitizer_coverage]
+            sanitizer_coverage, error_flow, metric_discipline,
+            chaos_coverage]

@@ -105,6 +105,14 @@ class ObjectLostError(RayTpuError):
         self.object_id_hex = object_id_hex
         super().__init__(msg or f"Object {object_id_hex} was lost")
 
+    def __reduce__(self):
+        # type(self): subclasses (ObjectReconstructionFailedError,
+        # OwnerDiedError) inherit this __init__, so they must unpickle
+        # as themselves — the error frame crosses the RPC reply
+        # boundary and the caller's `except OwnerDiedError` must work.
+        return (type(self), (self.object_id_hex,
+                             self.args[0] if self.args else ""))
+
 
 class ObjectReconstructionFailedError(ObjectLostError):
     pass

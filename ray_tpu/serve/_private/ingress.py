@@ -72,12 +72,45 @@ _MAX_BODY_BYTES = 1 << 30
 _WANT_HDRS = (b"content-length", b"content-type", b"accept",
               b"connection", b"x-rtpu-stream", b"expect")
 
-# replica/worker-death taxonomy: the request never produced a result
-# on a live replica — a fresh request may well succeed on a
-# replacement, so these answer 502 (bad gateway: the tier behind the
-# ingress failed), typed via X-RTPU-Error-Type.
+# The ingress boundary contract, as one literal the error-flow pass
+# machine-checks both ways (docs/static_analysis.md §14): every key
+# must name a taxonomy class, and every shippable taxonomy class must
+# resolve to a row via its base chain. Semantics: overload → 503
+# (retryable with a Retry-After hint), replica/worker death → 502
+# (bad gateway: the tier behind the ingress failed; a fresh request
+# may well succeed on a replacement), anything else → 500. The
+# `RayTpuError` row is the base-chain catch-all that keeps the table
+# closed over future taxonomy classes.
+_HTTP_STATUS_BY_TAXONOMY = {
+    "SystemOverloadError": 503,
+    "ActorError": 502,
+    "WorkerCrashedError": 502,
+    "ObjectLostError": 502,
+    "RayTpuError": 500,
+}
+
+# replica/worker-death taxonomy (the 502 rows above, plus the builtin
+# ConnectionError, which is not a taxonomy class and so cannot sit in
+# the table): kept as a tuple for the isinstance classification.
 _DEATH_ERRORS = (ActorError, WorkerCrashedError, ObjectLostError,
                  ConnectionError)
+
+
+def _status_for(e: BaseException) -> int:
+    """Resolve the response status through the taxonomy table by base
+    chain — the runtime twin of the error-flow pass's static walk."""
+    for klass in type(e).__mro__:
+        if klass.__name__ == "RayTpuError":
+            # catch-all row: defer past the builtin check, so an
+            # `as_instanceof_cause` derivative of a user-defined
+            # ConnectionError still classifies as replica death
+            break
+        status = _HTTP_STATUS_BY_TAXONOMY.get(klass.__name__)
+        if status is not None:
+            return status
+    if isinstance(e, ConnectionError):
+        return 502
+    return _HTTP_STATUS_BY_TAXONOMY["RayTpuError"]
 
 
 # ---------------------------------------------------------------------------
@@ -109,7 +142,8 @@ def classify_error(e: BaseException):
     if isinstance(e, TaskError) and e.cause is not None:
         e = e.as_instanceof_cause()
     name = _type_name(e)
-    if isinstance(e, SystemOverloadError):
+    status = _status_for(e)
+    if status == 503 and isinstance(e, SystemOverloadError):
         retry_after = max(1, int(round(
             getattr(e, "backoff_s", 0.0) or 1.0)))
         body = {"error": ("backpressure" if isinstance(e, BackpressureError)
@@ -120,7 +154,7 @@ def classify_error(e: BaseException):
         return (503, "Service Unavailable",
                 [("Retry-After", str(retry_after)),
                  ("X-RTPU-Error-Type", name)], body)
-    if isinstance(e, _DEATH_ERRORS):
+    if status == 502 and isinstance(e, _DEATH_ERRORS):
         body = {"error": "replica_failure", "error_type": name,
                 "retryable": True, "detail": _detail(e)}
         return (502, "Bad Gateway", [("X-RTPU-Error-Type", name)], body)

@@ -1,0 +1,33 @@
+"""Seeded chaos-coverage rot for the `chaos-coverage` pass.
+
+One bad injection point: ``fixture_zone.nowhere`` is fired below but
+appears in no ``docs/*.md`` chaos-matrix row and in no test literal —
+two findings, one per missing direction.  (The analysis_fixtures tree
+itself is excluded from the test scan, so this file can never
+self-satisfy its own coverage.)
+
+Good twins that must stay quiet: an annotated
+``# chaos-unreachable:`` site, and a fire point reusing the real
+``worker_pool.spawn`` key, which the repo's chaos matrix documents
+and ``tests/test_chaos_coverage.py`` arms.
+"""
+
+from ray_tpu._private import chaos
+
+
+def poke_uncovered(payload):
+    # BAD: neither documented nor exercised by any test
+    chaos.fire("fixture_zone", "nowhere")
+    return payload
+
+
+def poke_unreachable(payload):
+    # chaos-unreachable: only reachable when the fixture zone is
+    # compiled out, which the simulator never does
+    chaos.fire("fixture_zone", "unreachable")
+    return payload
+
+
+def poke_covered(payload):
+    chaos.fire("worker_pool", "spawn")
+    return payload

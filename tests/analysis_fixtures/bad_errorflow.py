@@ -1,0 +1,121 @@
+"""Seeded error-flow rot for the `error-flow` pass, with good twins.
+
+The taxonomy is self-contained (its own ``RayTpuError`` root) so the
+fixture links whole-program without ``ray_tpu/exceptions.py`` in the
+summary set.  Four bad cases, one finding each:
+
+1. ``LostShardError`` — custom ``__init__`` with no ``__reduce__``,
+   raised below: the error frame cannot cross a pickled reply
+   boundary without masking the real fault.
+2. ``BadShedError`` — subclasses ``SystemOverloadError`` with an
+   ``__init__`` that neither chains ``super().__init__`` nor assigns
+   ``retryable`` / ``backoff_s`` (the ``Exception.__init__`` direct
+   call does not count — it skips the overload contract).
+3. ``_HTTP_STATUS_BY_TAXONOMY`` maps ``GhostError`` — a dead row
+   naming no taxonomy class.
+4. ``swallow_badly`` — broad ``except`` over a taxonomy raise with no
+   re-raise and no ``# swallow-ok:`` annotation.
+
+Good twins that must stay quiet: ``GoodWireError`` (paired
+``__init__`` / ``__reduce__``), ``PlainChildError`` (no ``__init__``
+of its own — inherits the safe pair), ``GoodShedError`` (chains
+``super().__init__``), ``swallow_annotated`` (documented swallow) and
+``swallow_reraises`` (converts, does not drop).
+"""
+
+
+class RayTpuError(Exception):
+    pass
+
+
+class SystemOverloadError(RayTpuError):
+    def __init__(self, msg, retryable=True, backoff_s=0.5):
+        super().__init__(msg)
+        self.retryable = retryable
+        self.backoff_s = backoff_s
+
+    def __reduce__(self):
+        return (type(self),
+                (self.args[0], self.retryable, self.backoff_s))
+
+
+class LostShardError(RayTpuError):
+    """BAD: custom __init__, no __reduce__, raised in scope."""
+
+    def __init__(self, shard_id):
+        super().__init__(f"shard {shard_id} lost")
+        self.shard_id = shard_id
+
+
+class GoodWireError(RayTpuError):
+    def __init__(self, detail):
+        super().__init__(detail)
+        self.detail = detail
+
+    def __reduce__(self):
+        return (type(self), (self.detail,))
+
+
+class PlainChildError(GoodWireError):
+    """Good twin: no __init__ of its own — inherits the safe pair."""
+
+
+class BadShedError(SystemOverloadError):
+    """BAD: drops the retry contract on the floor."""
+
+    def __init__(self, queue):
+        Exception.__init__(self, f"{queue} full")
+        self.queue = queue
+
+
+class GoodShedError(SystemOverloadError):
+    def __init__(self, queue):
+        super().__init__(f"{queue} full", retryable=True, backoff_s=1.0)
+
+
+_HTTP_STATUS_BY_TAXONOMY = {
+    "SystemOverloadError": 503,
+    "GhostError": 502,
+    "RayTpuError": 500,
+}
+
+
+def ship_lost(shard_id):
+    raise LostShardError(shard_id)
+
+
+def ship_good(detail):
+    raise GoodWireError(detail)
+
+
+def ship_child():
+    raise PlainChildError("inherited constructor is wire-safe")
+
+
+def swallow_badly(flag):
+    try:
+        if flag:
+            raise LostShardError("s0")
+        return "ok"
+    except Exception:
+        return None
+
+
+def swallow_annotated(flag):
+    try:
+        if flag:
+            raise GoodWireError("probe")
+        return "ok"
+    except Exception:
+        # swallow-ok: probe failures are expected during rollout and
+        # the caller polls the authoritative state table instead
+        return None
+
+
+def swallow_reraises(flag):
+    try:
+        if flag:
+            raise GoodWireError("probe")
+        return "ok"
+    except Exception:
+        raise RayTpuError("probe failed")

@@ -1,0 +1,33 @@
+"""Seeded metric rot for the `metric-discipline` pass.
+
+One bad case: a ``ray_tpu_*`` gauge constructed outside the stats
+modules — a rogue declaration the registry (and the docs-table
+contract) cannot audit.  The good twin builds a gauge whose name is
+not in the ``ray_tpu_*`` namespace (third-party / user metrics are
+not the registry's business) and one whose name is computed (the
+pass only audits literal names; dynamic factories are wrapped by the
+stats modules themselves).
+
+Label-consistency and docs-table cases need a stats module and a
+``docs/`` tree, so they live in tmp_path tests rather than here —
+a detached fixture run checks declaration locality only.
+"""
+
+from ray_tpu.util.metrics import Gauge
+
+
+def install_rogue_gauge():
+    # BAD: ray_tpu_* constructor outside _private/stats.py
+    return Gauge("ray_tpu_fixture_rogue_depth",
+                 "queue depth observed by a module nobody audits",
+                 tag_keys=("queue",))
+
+
+def install_user_gauge():
+    # good twin: user namespace, not the registry's business
+    return Gauge("myapp_queue_depth", "user-owned metric")
+
+
+def install_dynamic_gauge(suffix):
+    # good twin: computed name — wrapped by the stats modules
+    return Gauge("ray_tpu_" + suffix, "factory-produced")

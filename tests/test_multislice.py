@@ -80,7 +80,27 @@ def _run_trainer(num_slices, ranks_per_slice, steps, **cfg_kw):
         snaps = tr.snapshots()
         stats = tr.dcn_stats()
         from ray_tpu.util import metrics
-        text = metrics.prometheus_text()
+
+        def _gauges_caught_up():
+            series = {}
+            for line in metrics.prometheus_text().splitlines():
+                if line.startswith("ray_tpu_dcn"):
+                    key, val = line.rsplit(" ", 1)
+                    series[key] = float(val)
+            if series.get("ray_tpu_dcn_bytes") != stats["bytes_tx"]:
+                return None
+            if series.get("ray_tpu_dcn_collective_ms", 0) <= 0:
+                return None
+            return metrics.prometheus_text()
+
+        if stats["bytes_tx"] == 0:
+            # flat run: no DCN tier, nothing to wait for
+            text = metrics.prometheus_text()
+        else:
+            # gauge publication trails the last step's stats update;
+            # scrape until the DCN counters catch up instead of racing
+            text = _poll(_gauges_caught_up, 10.0,
+                         "DCN gauges to match dcn_stats()")
         tr.shutdown()
         return hist, snaps, stats, text
     finally:

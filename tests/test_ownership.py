@@ -174,3 +174,24 @@ def test_wait_on_owned_refs(rt):
     ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=5)
     assert ready == [ref] and not_ready == []
     assert ray_tpu.get(ready[0]) == 41
+
+
+def test_object_lost_errors_pickle_round_trip():
+    """Regression (graftflow error-flow pass): ObjectLostError and its
+    subclasses cross the RPC reply boundary as pickled error frames —
+    a custom __init__ signature without a matching __reduce__ raises
+    TypeError INSIDE the reply path and masks the real fault."""
+    import pickle
+
+    from ray_tpu.exceptions import (ObjectReconstructionFailedError,
+                                    OwnerDiedError)
+    for cls in (ObjectLostError, ObjectReconstructionFailedError,
+                OwnerDiedError):
+        err = cls("deadbeef" * 5, "gone")
+        back = pickle.loads(pickle.dumps(err))
+        assert type(back) is cls           # subclasses survive as themselves
+        assert back.object_id_hex == err.object_id_hex
+        assert str(back) == str(err) == "gone"
+    # default message formatting also survives the round trip
+    back = pickle.loads(pickle.dumps(ObjectLostError("ab12")))
+    assert "ab12" in str(back)

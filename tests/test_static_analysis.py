@@ -458,12 +458,13 @@ def test_registered_methods_hook():
 # -- graftcheck v2: whole-program passes ------------------------------------
 
 
-def test_thirteen_passes_registered():
+def test_sixteen_passes_registered():
     from ray_tpu.devtools.analysis.passes import load_passes
     ids = [p.PASS_ID for p in load_passes()]
-    assert len(ids) == 13
+    assert len(ids) == 16
     for new in ("lock-order", "blocking-under-lock", "wire-shape",
-                "sanitizer-coverage"):
+                "sanitizer-coverage", "error-flow", "metric-discipline",
+                "chaos-coverage"):
         assert new in ids
 
 
@@ -833,8 +834,13 @@ def test_cache_prunes_deleted_files(tmp_path):
 def test_contract_manifest_in_sync():
     """The committed contracts.json must equal what --emit-contracts
     produces from the current tree: annotations changed without
-    re-emitting would hand graftsan a stale contract."""
+    re-emitting would hand graftsan a stale contract.  The committed
+    baseline must also only suppress passes that still exist — an
+    entry naming a renamed/retired pass is dead weight that LOOKS
+    like an accepted finding."""
     from ray_tpu.devtools.analysis import contracts
+    from ray_tpu.devtools.analysis.core import default_baseline_path
+    from ray_tpu.devtools.analysis.passes import load_passes
 
     path = contracts.default_manifest_path()
     assert os.path.exists(path), (
@@ -846,6 +852,13 @@ def test_contract_manifest_in_sync():
     assert committed == fresh, (
         "contracts.json is stale — re-run "
         "`python -m ray_tpu.devtools.analysis --emit-contracts`")
+
+    live = {p.PASS_ID for p in load_passes()}
+    with open(default_baseline_path(), encoding="utf-8") as f:
+        baselined = {e["pass"] for e in json.load(f)["findings"]}
+    assert baselined <= live, (
+        f"baseline.json suppresses nonexistent pass(es) "
+        f"{sorted(baselined - live)} — prune the stale entries")
 
 
 def test_contract_manifest_contents():
@@ -868,3 +881,200 @@ def test_contract_manifest_contents():
     assert escapes.get("ConnectionContext._send_lock"), (
         "_send_lock must carry its designed blocking-ok escape")
     assert m["chaos_points"], "chaos fire() sites must be compiled"
+
+
+# -- graftflow: error-flow / metric-discipline / chaos-coverage -------------
+
+
+def test_error_flow_fixture():
+    """Each seeded rot case fires exactly once; the good twins stay
+    quiet (see the fixture's docstring for the four cases)."""
+    unsuppressed, _ = _run([_fixture("bad_errorflow.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "error-flow"]
+    assert len(hits) == 4, [f.to_json() for f in hits]
+    by_ctx = {h.context: h.message for h in hits}
+    assert "LostShardError" in by_ctx
+    assert "no matching __reduce__" in by_ctx["LostShardError"]
+    assert "BadShedError" in by_ctx
+    assert "retryable" in by_ctx["BadShedError"]
+    assert "backoff_s" in by_ctx["BadShedError"]
+    assert "swallow_badly" in by_ctx
+    assert "swallow-ok" in by_ctx["swallow_badly"]
+    dead = [h for h in hits if h.context == "<module>"]
+    assert len(dead) == 1 and "GhostError" in dead[0].message
+    # good twins: quiet across the board
+    messages = " | ".join(h.message for h in hits)
+    assert "GoodWireError" not in messages
+    assert "PlainChildError" not in messages
+    assert "GoodShedError" not in messages
+    assert all(h.context not in ("swallow_annotated", "swallow_reraises")
+               for h in hits)
+
+
+def test_error_flow_links_cross_file_changed(tmp_path):
+    """The --changed contract for error-flow: the class definition in
+    link-only A plus the raise in scanned B yields the pickle-safety
+    finding anchored at A; without the link set the raise is just an
+    unknown name and nothing fires."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    exc = priv / "exc.py"
+    uses = priv / "uses.py"
+    exc.write_text(
+        "class RayTpuError(Exception):\n"
+        "    pass\n"
+        "class DroppedError(RayTpuError):\n"
+        "    def __init__(self, key):\n"
+        "        super().__init__(key)\n"
+        "        self.key = key\n")
+    uses.write_text(
+        "def boom(key):\n"
+        "    raise DroppedError(key)\n")
+    unsuppressed, _ = _run([str(uses)], root=str(tmp_path),
+                           link_paths=[str(priv)])
+    hits = [f for f in unsuppressed if f.pass_id == "error-flow"]
+    assert len(hits) == 1, [f.to_json() for f in hits]
+    assert hits[0].context == "DroppedError"
+    assert "exc.py" in hits[0].path
+    assert "uses.py:2" in hits[0].message     # raise site cited
+    # the same scan without the link set sees no taxonomy at all
+    unsuppressed, _ = _run([str(uses)], root=str(tmp_path))
+    assert [f for f in unsuppressed if f.pass_id == "error-flow"] == []
+
+
+def test_metric_discipline_fixture():
+    """The rogue ray_tpu_* constructor outside the stats modules
+    fires; the user-namespace and computed-name twins stay quiet."""
+    unsuppressed, _ = _run([_fixture("bad_metric.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "metric-discipline"]
+    assert len(hits) == 1, [f.to_json() for f in hits]
+    assert hits[0].context == "install_rogue_gauge"
+    assert "ray_tpu_fixture_rogue_depth" in hits[0].message
+    assert "outside the stats modules" in hits[0].message
+
+
+def test_metric_label_consistency(tmp_path):
+    """The same gauge re-declared with different tag_keys inside a
+    stats module is a shape conflict."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    stats = priv / "stats.py"
+    stats.write_text(
+        'a = Gauge("ray_tpu_fx_dup", "d", tag_keys=("node",))\n'
+        'b = Gauge("ray_tpu_fx_dup", "d", tag_keys=("node", "zone"))\n')
+    unsuppressed, _ = _run([str(stats)], root=str(tmp_path))
+    hits = [f for f in unsuppressed if f.pass_id == "metric-discipline"]
+    assert len(hits) == 1, [f.to_json() for f in hits]
+    assert "re-declared with tag_keys" in hits[0].message
+    assert "dropping labels" in hits[0].message
+
+
+def test_metric_doc_contract_both_ways(tmp_path):
+    """Docs-table contract, all four failure shapes at once: a ghost
+    row, an undocumented declaration, a double-owned gauge, and a doc
+    label the declaration does not carry."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    (tmp_path / "docs").mkdir()
+    stats = priv / "stats.py"
+    stats.write_text(
+        'doc = Gauge("ray_tpu_fx_documented", "d", tag_keys=("node",))\n'
+        'und = Gauge("ray_tpu_fx_undocumented", "d")\n'
+        'twi = Gauge("ray_tpu_fx_twice", "d")\n')
+    (tmp_path / "docs" / "metrics.md").write_text(
+        "# registry\n"
+        "\n"
+        "| gauge | meaning |\n"
+        "|---|---|\n"
+        "| `ray_tpu_fx_documented{node,zone}` | zone is not declared |\n"
+        "| `ray_tpu_fx_ghost` | nobody declares this |\n"
+        "| `ray_tpu_fx_twice` | first owner |\n"
+        "| `ray_tpu_fx_twice` | second owner |\n"
+        "\n"
+        "prose mention of ray_tpu_fx_undocumented must NOT count\n")
+    unsuppressed, _ = _run([str(stats)], root=str(tmp_path))
+    hits = [f for f in unsuppressed if f.pass_id == "metric-discipline"]
+    msgs = {h.message for h in hits}
+    assert len(hits) == 4, [f.to_json() for f in hits]
+    assert any("ghost gauge" in m and "ray_tpu_fx_ghost" in m
+               for m in msgs)
+    assert any("appears in no docs table" in m
+               and "ray_tpu_fx_undocumented" in m for m in msgs)
+    assert any("2 docs table rows" in m and "ray_tpu_fx_twice" in m
+               for m in msgs)
+    assert any("zone" in m and "does not carry" in m for m in msgs)
+
+
+def test_chaos_coverage_fixture():
+    """The uncovered point reports once per missing direction; the
+    annotated-unreachable and really-covered twins stay quiet."""
+    unsuppressed, _ = _run([_fixture("bad_chaoscov.py")])
+    hits = [f for f in unsuppressed if f.pass_id == "chaos-coverage"]
+    assert len(hits) == 2, [f.to_json() for f in hits]
+    # concatenation keeps the needle itself out of this test file —
+    # the pass scans tests/ and must not find the key here
+    needle = "fixture_zone" + "." + "nowhere"
+    assert all(needle in h.message for h in hits)
+    msgs = " | ".join(h.message for h in hits)
+    assert "no docs chaos-matrix" in msgs
+    assert "no test literal" in msgs
+    assert "unreachable" not in needle and all(
+        ("fixture_zone" + ".unreachable") not in h.message for h in hits)
+
+
+def test_chaos_coverage_directions_and_grammar(tmp_path):
+    """Per-direction reporting plus the degrading needle grammar: an
+    f-string detail matches by prefix and a dynamic component matches
+    any `.point.` rule line."""
+    priv = tmp_path / "_private"
+    priv.mkdir()
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "tests").mkdir()
+    mod = priv / "mod.py"
+    mod.write_text(
+        "from ray_tpu._private import chaos\n"
+        "def f(component, tag):\n"
+        "    chaos.fire('zoneA', 'alpha')\n"
+        "    chaos.fire('zoneB', 'beta')\n"
+        "    chaos.fire('zoneC', 'save', f'save_{tag}')\n"
+        "    chaos.fire(component, 'send')\n")
+    (tmp_path / "docs" / "chaos.md").write_text(
+        "| `zoneA.alpha` | documented but untested |\n"
+        "| `zoneC.save.save_weights` | prefix-matches the f-string |\n"
+        "| `wire.send.echo` | matches the dynamic component |\n")
+    (tmp_path / "tests" / "test_fx.py").write_text(
+        "RULES = 'zoneB.beta:drop@1;zoneC.save.save_opt:drop@1'\n"
+        "MORE = 'wire.send.echo:delay=0.1@1'\n")
+    unsuppressed, _ = _run([str(mod)], root=str(tmp_path))
+    hits = [f for f in unsuppressed if f.pass_id == "chaos-coverage"]
+    assert len(hits) == 2, [f.to_json() for f in hits]
+    by_key = {h.message.split("`")[1]: h.message for h in hits}
+    assert set(by_key) == {"zoneA.alpha", "zoneB.beta"}
+    assert "no test literal" in by_key["zoneA.alpha"]
+    assert "no docs chaos-matrix" in by_key["zoneB.beta"]
+
+
+def test_ci_mode_aggregates():
+    """`--ci` is the one-flag CI gate: full tree, timings printed,
+    exit 0 on a clean tree — and a warm-cache run stays inside the
+    10 s budget.  Scan-shaping flags are rejected (exit 2)."""
+    import time as _time
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "ray_tpu.devtools.analysis", "--ci"]
+    subprocess.run(cmd, capture_output=True, text=True, env=env,
+                   cwd=ROOT, timeout=300)          # warm the cache
+    t0 = _time.perf_counter()
+    proc = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          cwd=ROOT, timeout=300)
+    elapsed = _time.perf_counter() - t0
+    assert proc.returncode == 0, (
+        f"--ci found unsuppressed issues:\n{proc.stdout}\n{proc.stderr}")
+    assert "timing " in proc.stdout                # --timings implied
+    assert "graftcheck: 0 finding(s)" in proc.stdout
+    assert elapsed < 10.0, f"cached --ci run took {elapsed:.2f}s"
+
+    proc = subprocess.run(cmd + ["ray_tpu/"], capture_output=True,
+                          text=True, env=env, cwd=ROOT, timeout=300)
+    assert proc.returncode == 2
+    assert "aggregate mode" in proc.stderr
