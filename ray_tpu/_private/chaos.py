@@ -48,6 +48,21 @@ A **rule** names an event and an action::
 - ``xCount``: keep firing for this many consecutive matches
   (default 1; ``x*`` = every match from ``@after`` on).
 
+Rules can carry a **phase** tag (``install_phase``): the soak plane's
+chaos scheduler arms one phase's rule set at a phase boundary and
+disarms it at the next, without disturbing rules outside the phase.
+Both operations are a single atomic swap of the rule list under the
+plane lock, so a concurrent ``fire()`` always observes either the
+whole old rule set or the whole new one — never a half-installed
+phase.
+
+The plane can also mirror every fired event to a **JSONL fault-event
+log** (``set_event_log``; child processes inherit it through
+``RTPU_CHAOS_LOG``). The soak scheduler writes its arm/disarm
+timeline into the same stream; see docs/soak.md for which record
+kinds are digest-stable (the replay contract) and which are
+informational.
+
 Rules are matched first-hit-wins in install order. Matching and
 trigger counting are fully deterministic; an optional ``%prob``
 suffix makes a rule probabilistic, evaluated against the plane's
@@ -70,18 +85,20 @@ path stays effectively free.
 from __future__ import annotations
 
 import fnmatch
+import json
 import logging
 import os
 import random
 import re
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 logger = logging.getLogger(__name__)
 
 ENV_VAR = "RTPU_CHAOS"
 ENV_SEED_VAR = "RTPU_CHAOS_SEED"
+ENV_LOG_VAR = "RTPU_CHAOS_LOG"
 
 # Exit status of a chaos 'kill' — distinctive, so tests (and humans
 # reading a raylet log) can tell an injected death from a real crash.
@@ -109,11 +126,12 @@ class ChaosRule:
     """One parsed injection rule plus its live trigger counters."""
 
     __slots__ = ("component", "point", "method", "action", "arg",
-                 "after", "count", "prob", "matched", "fired")
+                 "after", "count", "prob", "matched", "fired", "phase")
 
     def __init__(self, component: str, point: str, method: str,
                  action: str, arg: float = 0.0, after: int = 1,
-                 count: int = 1, prob: Optional[float] = None):
+                 count: int = 1, prob: Optional[float] = None,
+                 phase: Optional[str] = None):
         if action not in ACTIONS:
             raise ChaosRuleError(
                 f"unknown chaos action {action!r} (one of {ACTIONS})")
@@ -128,6 +146,7 @@ class ChaosRule:
         self.after = after
         self.count = count          # -1 = unlimited
         self.prob = prob
+        self.phase = phase          # install_phase scope tag (or None)
         self.matched = 0            # events this rule pattern-matched
         self.fired = 0              # events it actually acted on
 
@@ -167,40 +186,119 @@ class ChaosPlane:
 
     def __init__(self, seed: int = 0):
         self._lock = threading.Lock()
+        # The rule list is treated as IMMUTABLE: every mutation builds
+        # a fresh list and swaps it in with one assignment under _lock,
+        # so a concurrent fire() observes either the whole old set or
+        # the whole new set — never a partially replaced one. (Rule
+        # trigger counters still mutate in place; fire() holds _lock
+        # for the whole match-and-count step.)
         self._rules: List[ChaosRule] = []  # guarded-by: _lock
         self._rng = random.Random(seed)
         # fired events, for assertions: (component, point, method, action)
         self.events: List[Tuple[str, str, str, str]] = []  # guarded-by: _lock
         self.armed = False
+        self._event_log_path: Optional[str] = None
+        self._event_log_lock = threading.Lock()
+        self._event_log_fh = None
 
-    def install(self, rules: Union[str, Sequence],
-                seed: Optional[int] = None) -> None:
-        """Add rules (a spec string with ``;``-separated rules, or a
-        sequence of strings / ChaosRule objects). Arms the plane."""
+    @staticmethod
+    def _parse_rules(rules: Union[str, Sequence],
+                     phase: Optional[str] = None) -> List[ChaosRule]:
         parsed: List[ChaosRule] = []
         if isinstance(rules, str):
             rules = [r for r in rules.split(";") if r.strip()]
         for r in rules:
-            parsed.append(r if isinstance(r, ChaosRule)
-                          else ChaosRule.parse(r))
+            rule = r if isinstance(r, ChaosRule) else ChaosRule.parse(r)
+            if phase is not None:
+                rule.phase = phase
+            parsed.append(rule)
+        return parsed
+
+    def install(self, rules: Union[str, Sequence],
+                seed: Optional[int] = None) -> None:
+        """Add rules (a spec string with ``;``-separated rules, or a
+        sequence of strings / ChaosRule objects). Arms the plane.
+        The new rule set becomes visible to ``fire()`` atomically."""
+        parsed = self._parse_rules(rules)
         with self._lock:
             if seed is not None:
                 self._rng = random.Random(seed)
-            self._rules.extend(parsed)
+            self._rules = self._rules + parsed    # atomic swap
             self.armed = bool(self._rules)
         if parsed:
             logger.warning("chaos plane armed: %d rule(s) active",
                            len(parsed))
 
+    def install_phase(self, phase: str, rules: Union[str, Sequence],
+                      seed: Optional[int] = None) -> None:
+        """Replace the rule set of one named phase in a single atomic
+        swap: any previous rules tagged ``phase`` go away and the new
+        ones appear in the same assignment, leaving rules outside the
+        phase (and their trigger counters) untouched."""
+        parsed = self._parse_rules(rules, phase=phase)
+        with self._lock:
+            if seed is not None:
+                self._rng = random.Random(seed)
+            kept = [r for r in self._rules if r.phase != phase]
+            self._rules = kept + parsed           # atomic swap
+            self.armed = bool(self._rules)
+        logger.warning("chaos phase %r armed: %d rule(s)",
+                       phase, len(parsed))
+
+    def clear_phase(self, phase: str) -> int:
+        """Atomically remove every rule tagged ``phase``; rules outside
+        the phase keep running with their counters intact. Returns the
+        number of rules removed."""
+        with self._lock:
+            kept = [r for r in self._rules if r.phase != phase]
+            removed = len(self._rules) - len(kept)
+            self._rules = kept                    # atomic swap
+            self.armed = bool(self._rules)
+        if removed:
+            logger.warning("chaos phase %r disarmed: %d rule(s)",
+                           phase, removed)
+        return removed
+
     def clear(self) -> None:
         with self._lock:
-            self._rules.clear()
+            self._rules = []
             self.events.clear()
             self.armed = False
 
     def rules(self) -> List[ChaosRule]:
         with self._lock:
             return list(self._rules)
+
+    # -- JSONL fault-event log -----------------------------------------
+
+    def set_event_log(self, path: Optional[str]) -> None:
+        """Mirror every fired event to ``path`` as one JSON line
+        (append mode, flushed per record so a ``kill`` firing right
+        after still leaves its record on disk). ``None`` detaches."""
+        fh = open(path, "a", encoding="utf-8") if path else None
+        with self._event_log_lock:
+            old, self._event_log_fh = self._event_log_fh, fh
+            self._event_log_path = path
+        if old is not None:
+            try:
+                old.close()
+            except OSError:  # pragma: no cover - best effort
+                pass
+
+    def log_event(self, record: Dict) -> None:
+        """Append one JSON record to the fault-event log (no-op when
+        no log is attached). Used by fire() for ``kind=fire`` records
+        and by the soak scheduler for its arm/disarm timeline."""
+        with self._event_log_lock:
+            if self._event_log_fh is None:
+                return
+            try:
+                self._event_log_fh.write(
+                    json.dumps(record, sort_keys=True) + "\n")
+                self._event_log_fh.flush()
+            except OSError:  # pragma: no cover - log is best effort
+                logger.debug("chaos event log write failed",
+                             exc_info=True)
 
     def fire(self, component: str, point: str, method: str = ""
              ) -> Optional[str]:
@@ -240,6 +338,12 @@ class ChaosPlane:
                 break
         if action is None:
             return None, 0.0
+        # fire records are informational (timing-dependent, excluded
+        # from the soak replay digest); written before kill so the
+        # record survives the process.
+        self.log_event({"kind": "fire", "component": component,
+                        "point": point, "method": method,
+                        "action": action, "pid": os.getpid()})
         if action == "delay":
             time.sleep(arg)
             return None, 0.0
@@ -286,6 +390,23 @@ def install(rules: Union[str, Sequence], seed: Optional[int] = None
     _plane.install(rules, seed=seed)
 
 
+def install_phase(phase: str, rules: Union[str, Sequence],
+                  seed: Optional[int] = None) -> None:
+    _plane.install_phase(phase, rules, seed=seed)
+
+
+def clear_phase(phase: str) -> int:
+    return _plane.clear_phase(phase)
+
+
+def set_event_log(path: Optional[str]) -> None:
+    _plane.set_event_log(path)
+
+
+def log_event(record: Dict) -> None:
+    _plane.log_event(record)
+
+
 def clear() -> None:
     _plane.clear()
 
@@ -300,6 +421,12 @@ def maybe_arm() -> None:
     config knob. Called at every process entrypoint (driver init,
     raylet/GCS main, worker_main); idempotent when nothing is set.
     The env var wins — it is how tests scope rules to one child."""
+    log_path = os.environ.get(ENV_LOG_VAR, "")
+    if log_path and _plane._event_log_path is None:
+        try:
+            _plane.set_event_log(log_path)
+        except OSError:  # pragma: no cover - log is best effort
+            logger.debug("chaos event log unavailable", exc_info=True)
     if _plane.armed:
         return
     spec = os.environ.get(ENV_VAR, "")

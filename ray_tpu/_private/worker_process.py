@@ -631,6 +631,17 @@ class ExecutionEnv:
         async-actor semantics). Returns the ("done", ...) reply."""
         import asyncio
         import time as _time
+        from ray_tpu._private import chaos
+        # Same kill-at-exec-entry point as the sync path: async actors
+        # (serve replicas, asyncio deployments) would otherwise be
+        # unreachable by `worker.exec.<name>:kill` rules. Flush any
+        # deferred replies first — completed-but-buffered replies must
+        # outlive a kill here, or replay re-runs their calls.
+        flush = getattr(emit, "flush_deferred", None)
+        if flush is not None:
+            flush()
+        if chaos._plane.armed:
+            chaos.fire("worker", "exec", payload.get("name", ""))
         task_id = payload["task_id"]
         t_start = _time.perf_counter()
         # Task identity rides the per-asyncio-task context: coroutines
@@ -935,6 +946,10 @@ class _AsyncActorLoop:
     async def _call(self, payload: dict) -> None:
         try:
             async with self._sem:
+                # blocking-ok: _sem is the actor's concurrency
+                # limiter — a chaos delay sleeping under it occupies
+                # a slot exactly like a slow user method would; that
+                # IS the injected fault
                 reply = await self._env.execute_async(payload,
                                                       emit=self._emit)
         except BaseException as e:   # noqa: BLE001 — incl. CancelledError

@@ -12,6 +12,10 @@ hang instead of a handled fault.  Two directions per point:
 - **tests**: the key must appear as a literal in some file under
   ``tests/`` — a rule string, an ``Expect`` pattern, or an events
   assertion all count, because each one arms or observes the point.
+  The soak plane's weight table (``ray_tpu/soak/schedule.py``) counts
+  too: every ``ArmSpec`` names its registry key as a literal, and any
+  seed can draw and arm it, so a schedule entry IS an exerciser —
+  one the long soak actually fires, not just a string in a test.
 
 A point that genuinely cannot be exercised (e.g. would wedge the
 respawn loop) carries ``# chaos-unreachable: <why>`` at the fire
@@ -38,7 +42,7 @@ from typing import Dict, List, Tuple
 from ray_tpu.devtools.analysis.core import Finding
 
 PASS_ID = "chaos-coverage"
-VERSION = 1
+VERSION = 2
 
 _SCOPES = ("_private/", "collective/", "multislice/", "serve/",
            "data/", "analysis_fixtures/")
@@ -84,6 +88,11 @@ def _scan(root: str) -> Tuple[List[str], List[str]]:
         for fn in sorted(filenames):
             if fn.endswith(".py"):
                 tests.extend(_read_lines(os.path.join(dirpath, fn)))
+    # the soak schedule's weight table is an exerciser too: each
+    # ArmSpec carries its registry key as a literal and any seed can
+    # draw + arm it, so schedule entries count as test coverage
+    tests.extend(_read_lines(
+        os.path.join(root, "ray_tpu", "soak", "schedule.py")))
     return docs, tests
 
 

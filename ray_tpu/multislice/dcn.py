@@ -154,7 +154,19 @@ def dcn_allreduce(tensor, group_name: str,
     """Allreduce among the per-slice leaders over the DCN tier. Same
     rendezvous mechanics as ``collective.allreduce`` plus the cost
     model, accounting, and ``multislice.dcn.*`` chaos points."""
-    g = _cc._get(group_name)
+    g = _cc._groups.get(group_name)
+    if g is None:
+        # A restarted leader can be driven into a step before the
+        # coordinator's rejoin_dcn re-join lands in this process (the
+        # DCN join arrives out-of-band, unlike the slice-group join
+        # the gang-restart plane re-issues ahead of queued calls).
+        # That ordering is transient by construction, so abort typed —
+        # the trainer's recover() taxonomy re-drives the step after
+        # the join instead of surfacing a raw RuntimeError.
+        from ray_tpu.exceptions import CollectiveAbortError
+        raise CollectiveAbortError(
+            f"no DCN group {group_name!r} in this process yet "
+            "(rejoin in flight)", group=group_name)
     _cc._check_abort(g)
     model = DcnCostModel.from_config()
     t0 = time.perf_counter()

@@ -256,8 +256,15 @@ class SliceSet:
             root = col.group_root(group)
             st = col.collective.read_group_state(root)
             epoch = int(st.get("epoch", 1)) if st else 1
-            if os.path.exists(col.collective._abort_marker(root, epoch)):
-                out.append(group)
+            marker = col.collective._abort_marker(root, epoch)
+            if os.path.exists(marker):
+                try:
+                    with open(marker, encoding="utf-8") as f:
+                        reason = f.read().strip()
+                except OSError:
+                    reason = ""
+                out.append(f"{group}@ep{epoch}"
+                           + (f" ({reason})" if reason else ""))
         return out
 
     def wait_all_alive(self, timeout_s: float = 60.0) -> None:

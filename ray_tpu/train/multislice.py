@@ -84,6 +84,32 @@ class _SliceTrainWorker:
     def ping(self):
         return "up"
 
+    def is_configured(self):
+        return self._fns is not None
+
+    def reconfigure(self, blob, meta, adopt=None):
+        """Re-arm a rank that restarted BARE — its newest fully
+        committed checkpoint generation predates :meth:`configure`
+        (blob/state None), which happens when a kill lands inside the
+        rank's very first save window. Re-ships the training fns and
+        adopts a configured peer's replicated ``(steps, state)`` so
+        the regular catch-up path can align it. Issued on EVERY rank
+        for checkpoint call-count symmetry; configured ranks no-op."""
+        if self._fns is not None:
+            return False
+        import cloudpickle
+        self._blob = blob
+        self._meta = dict(meta)
+        self._fns = cloudpickle.loads(blob)
+        if adopt is not None:
+            steps, state = adopt
+            self.steps = int(steps)
+            self.state = np.asarray(state)
+        else:
+            self.state = np.asarray(self._fns[0]())
+            self.steps = 0
+        return True
+
     def arm(self, rule):
         """Install a chaos rule in this rank's process (the fault-
         injection plane's per-process hook; tests aim kills at one
@@ -91,6 +117,15 @@ class _SliceTrainWorker:
         symmetry)."""
         from ray_tpu._private import chaos
         chaos.install(rule)
+        return True
+
+    def disarm(self):
+        """Clear every chaos rule in this rank's process. Like
+        :meth:`arm`, callers issue it on EVERY rank of the gang so
+        checkpoint call counts stay aligned (the soak plane's trainer
+        scope disarms after each faulted epoch)."""
+        from ray_tpu._private import chaos
+        chaos.clear()
         return True
 
     def configure(self, blob, meta):
@@ -257,6 +292,7 @@ class MultiSliceTrainer:
             or f"mslice_{uuid.uuid4().hex[:8]}"
         self.slice_set = None
         self.workers: List[List] = []       # handles by slice
+        self._metas: List[dict] = []        # per-rank meta, flat order
         self._next_step = 0
         self.history: List[Tuple[int, float]] = []
 
@@ -278,6 +314,7 @@ class MultiSliceTrainer:
             ray_tpu.get([h.ping.remote() for h in flat], timeout=60)
             blob = cloudpickle.dumps(self._fns)
             refs = []
+            self._metas = []
             for k, members in enumerate(self.workers):
                 for i, h in enumerate(members):
                     meta = dict(
@@ -293,6 +330,7 @@ class MultiSliceTrainer:
                                    if cfg.num_slices > 1 else None),
                         reduce_op=cfg.reduce_op,
                         collective_timeout_s=cfg.collective_timeout_s)
+                    self._metas.append(meta)
                     refs.append(h.configure.remote(blob, meta))
             ray_tpu.get(refs, timeout=60)
             self.slice_set = SliceSet.create(
@@ -500,6 +538,31 @@ class MultiSliceTrainer:
                 "marker at their live epoch with every member healthy; "
                 "intra-slice epochs only re-form through a gang "
                 "restart — tear the trainer down and start() fresh")
+        # A rank can restart BARE: when the kill landed inside its
+        # very first save window, the newest fully committed
+        # generation is the pre-configure one (blob/state None), and
+        # the catch-up below would crash untyped unpacking its fns.
+        # Re-ship the fns and adopt a configured peer's replicated
+        # state (every rank gets both calls for checkpoint call-count
+        # symmetry; configured ranks no-op the reconfigure).
+        flat = [h for s in self.workers for h in s]
+        flags = ray_tpu.get([h.is_configured.remote() for h in flat],
+                            timeout=cfg.recover_timeout_s)
+        if not all(flags):
+            import cloudpickle
+            bare_snaps = ray_tpu.get(
+                [h.snapshot.remote() for h in flat],
+                timeout=cfg.recover_timeout_s)
+            donor = None
+            for ok, (st, sv) in zip(flags, bare_snaps):
+                if ok and (donor is None or int(st) > donor[0]):
+                    donor = (int(st), sv)
+            blob = cloudpickle.dumps(self._fns)
+            adopt = ray_tpu.put(donor) if donor is not None else None
+            ray_tpu.get(
+                [h.reconfigure.remote(blob, self._metas[j], adopt)
+                 for j, h in enumerate(flat)],
+                timeout=cfg.recover_timeout_s)
         # also for num_slices=1 (where steps never touch the DCN
         # group): the fence still marked the set DEGRADED and bumped
         # its epoch, and only the re-join flips the row back ALIVE

@@ -1,0 +1,54 @@
+"""Shared gauge-settle assertions for the test suite.
+
+One definition of "this gauge is back at baseline": the primitives
+live in :mod:`ray_tpu.soak.oracle` (the composed soak's invariant
+oracle asserts the exact same thing per chaos phase), and this module
+wraps them in pytest-friendly asserts. Deadline-polled, never a fixed
+sleep — a probe holds when every predicate passes in the SAME round.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+from ray_tpu.soak.oracle import (backpressure_settle_probe, gauge_samples,
+                                 gauge_value, serve_settle_probes,
+                                 wait_settled)
+
+__all__ = ["assert_gauge_zero", "assert_serve_settled",
+           "backpressure_settle_probe", "gauge", "gauge_samples"]
+
+
+def gauge(name: str, labels: Optional[Dict[str, str]] = None
+          ) -> Optional[float]:
+    """Current value of the first matching sample (None if absent)."""
+    return gauge_value(name, labels)
+
+
+def assert_gauge_zero(name: str,
+                      labels: Optional[Dict[str, str]] = None,
+                      timeout: float = 10.0) -> None:
+    """Deadline-poll gauge ``name`` back to zero (absent counts as
+    zero: a series that never existed is at baseline by definition)."""
+    def probe() -> bool:
+        v = gauge_value(name, labels)
+        return v is None or v == 0
+
+    ok, detail = wait_settled(
+        [(f"{name}{labels or ''} == 0", probe)], timeout=timeout)
+    assert ok, detail
+
+
+def assert_serve_settled(
+        *deployments: str, timeout: float = 20.0,
+        extra_probes: Sequence[Tuple[str, Callable[[], bool]]] = ()
+        ) -> None:
+    """Deadline-poll until every named deployment is quiet — no queued
+    or ongoing requests in ``serve.status()`` AND the queue-depth
+    gauge at zero — plus any ``extra_probes``, all in the same round.
+    The assertion previously hand-rolled (with fixed windows) across
+    the overload / batching / ingress tests."""
+    probes = serve_settle_probes(list(deployments))
+    probes.extend(extra_probes)
+    ok, detail = wait_settled(probes, timeout=timeout)
+    assert ok, detail

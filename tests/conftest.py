@@ -40,8 +40,9 @@ import pytest
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
-        "slow: long-running smoke (sanitized chaos run); excluded by "
-        "the tier-1 `-m 'not slow'` selection")
+        "slow: long-running smoke (sanitized chaos run, the "
+        "docs/soak.md long soak); excluded by the tier-1 "
+        "`-m 'not slow'` selection")
 
 
 # ---------------------------------------------------------------------------

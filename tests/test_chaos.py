@@ -93,6 +93,77 @@ def test_chaos_probabilistic_rules_reproduce_under_fixed_seed():
     assert run(99) != a                 # different seed: different draw
 
 
+def test_chaos_phase_scoping_preserves_unphased_rules_and_counters():
+    """install_phase/clear_phase operate ONLY on their phase's rules:
+    unphased rules survive with their live trigger counters intact
+    (a phase swap mid-soak must not reset another rule's @after
+    progress), and clearing one phase leaves a different phase armed."""
+    plane = ChaosPlane()
+    plane.install("c.send.base:drop@3x*")
+    plane.fire("c", "send", "base")     # matched=1: counter progress
+    plane.fire("c", "send", "base")     # matched=2
+
+    plane.install_phase("p0", "c.send.a:drop")
+    plane.install_phase("p1", ["c.send.b:drop", "c.send.bb:sever"])
+    assert len(plane.rules()) == 4
+
+    # replacing a phase swaps ONLY that phase's rules
+    plane.install_phase("p0", "c.send.a2:dup")
+    methods = {r.method for r in plane.rules()}
+    assert methods == {"base", "a2", "b", "bb"}
+
+    assert plane.clear_phase("p1") == 2
+    assert plane.clear_phase("p1") == 0     # idempotent
+    methods = {r.method for r in plane.rules()}
+    assert methods == {"base", "a2"}
+
+    # the unphased rule kept its counter: third match fires
+    assert plane.fire("c", "send", "base") == "drop"
+    assert plane.armed
+    plane.clear_phase("p0")
+    assert plane.armed                      # unphased rule still there
+
+
+def test_chaos_phase_swap_atomic_under_concurrent_fire():
+    """A fire() racing install_phase/clear_phase churn observes either
+    the whole old rule set or the whole new one — never a torn state
+    where one of a phase's two complementary rules is installed
+    without the other. The two rules match DISTINCT methods fired
+    back-to-back; a torn swap shows up as exactly one of the pair
+    acting."""
+    import threading
+
+    plane = ChaosPlane()
+    stop = threading.Event()
+    torn = []
+
+    def swapper():
+        while not stop.is_set():
+            plane.install_phase(
+                "p", ["c.send.x:drop@1x*", "c.send.y:drop@1x*"])
+            plane.clear_phase("p")
+
+    threads = [threading.Thread(target=swapper) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(3000):
+            a = plane.fire("c", "send", "x")
+            b = plane.fire("c", "send", "y")
+            # complete-set check is statistical across the pair: both
+            # present or both absent is consistent; we tolerate a swap
+            # BETWEEN the two fires (a!=b with a whole set installed),
+            # so assert the plane itself never exposes a partial list
+            rules = plane.rules()
+            if {r.phase for r in rules} == {"p"} and len(rules) == 1:
+                torn.append((a, b, [r.method for r in rules]))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not torn, f"partial phase rule set observed: {torn[:3]}"
+
+
 # ---------------------------------------------------------------------------
 # transport hardening (rpc layer units)
 

@@ -174,16 +174,10 @@ def test_burst_over_queue_bound_sheds_and_loses_nothing(tmp_path):
 
         # observability satellite: the gauges moved and the live
         # backpressure gauge returned to zero after recovery
-        from ray_tpu.util import metrics
-        text = metrics.prometheus_text()
-        shed_line = [ln for ln in text.splitlines()
-                     if ln.startswith("ray_tpu_tasks")
-                     and 'state="shed"' in ln]
-        assert shed_line and float(shed_line[0].split()[-1]) > 0
-        bp_line = [ln for ln in text.splitlines()
-                   if ln.startswith("ray_tpu_tasks")
-                   and 'state="backpressured"' in ln]
-        assert bp_line and float(bp_line[0].split()[-1]) == 0
+        from tests._gauge_util import assert_gauge_zero, gauge
+        shed = gauge("ray_tpu_tasks", {"state": "shed"})
+        assert shed is not None and shed > 0
+        assert_gauge_zero("ray_tpu_tasks", {"state": "backpressured"})
     finally:
         cluster.shutdown()
         get_config().reset()

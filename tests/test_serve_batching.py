@@ -194,26 +194,11 @@ def test_shed_surfaces_backpressure_error(serve_instance):
     results = ray_tpu.get(accepted, timeout=120)
     assert len(results) == len(accepted)
     # gauges: serve sheds fold into ray_tpu_tasks{state=shed}; the
-    # queue gauge returns to baseline
-    from ray_tpu.util import metrics
-    text = metrics.prometheus_text()
-    shed_line = [ln for ln in text.splitlines()
-                 if ln.startswith("ray_tpu_tasks")
-                 and 'state="shed"' in ln]
-    assert shed_line and float(shed_line[0].split()[-1]) >= len(sheds)
-    deadline = time.monotonic() + 15
-    while time.monotonic() < deadline:
-        st = serve.status()["Slow"]
-        if st["queued_requests"] == 0 and st["ongoing_requests"] == 0:
-            break
-        time.sleep(0.05)
-    st = serve.status()["Slow"]
-    assert st["queued_requests"] == 0 and st["ongoing_requests"] == 0
-    text = metrics.prometheus_text()
-    q_line = [ln for ln in text.splitlines()
-              if ln.startswith("ray_tpu_serve_queue_depth")
-              and 'deployment="Slow"' in ln]
-    assert q_line and float(q_line[0].split()[-1]) == 0
+    # deployment then settles (queued/ongoing AND queue-depth gauge)
+    from tests._gauge_util import assert_serve_settled, gauge
+    shed = gauge("ray_tpu_tasks", {"state": "shed"})
+    assert shed is not None and shed >= len(sheds)
+    assert_serve_settled("Slow", timeout=15)
 
 
 def test_http_shed_returns_503_with_retry_after(serve_instance):

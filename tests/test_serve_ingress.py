@@ -354,14 +354,8 @@ def test_chaos_kill_mid_stream_surfaces_typed_terminal(serve_instance):
     finally:
         s.close()
     assert serve_stats.snapshot()["stream_errors"] >= 1
-    deadline = time.monotonic() + 20
-    while time.monotonic() < deadline:
-        st = serve.status()["Gen"]
-        if st["ongoing_requests"] == 0 and st["queued_requests"] == 0:
-            break
-        time.sleep(0.1)
-    st = serve.status()["Gen"]
-    assert st["ongoing_requests"] == 0 and st["queued_requests"] == 0
+    from tests._gauge_util import assert_serve_settled
+    assert_serve_settled("Gen", timeout=20)
 
 
 def test_client_disconnect_mid_stream_releases_refs(serve_instance):
@@ -387,22 +381,15 @@ def test_client_disconnect_mid_stream_releases_refs(serve_instance):
     first = json.loads(next(_iter_chunks(f)))
     assert first == {"i": 0}
     s.close()                           # walk away mid-stream
-    deadline = time.monotonic() + 30
-    settled = False
-    while time.monotonic() < deadline:
-        st = serve.status()["Gen"]
+
+    def _parked_drained() -> bool:
         with w._ready_cb_lock:
-            parked = len(w._ready_callbacks)
-        if (st["ongoing_requests"] == 0 and st["queued_requests"] == 0
-                and parked == 0):
-            settled = True
-            break
-        time.sleep(0.1)
-    st = serve.status()["Gen"]
-    with w._ready_cb_lock:
-        parked = len(w._ready_callbacks)
-    assert settled, (f"leak after disconnect: status={st}, "
-                     f"parked_callbacks={parked}")
+            return len(w._ready_callbacks) == 0
+
+    from tests._gauge_util import assert_serve_settled
+    assert_serve_settled(
+        "Gen", timeout=30,
+        extra_probes=[("parked ready-callbacks == 0", _parked_drained)])
 
 
 def test_first_token_gauge_populated(serve_instance):
