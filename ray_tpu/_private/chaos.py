@@ -33,7 +33,15 @@ A **rule** names an event and an action::
   peers abort via the liveness plane, ``kill`` dies mid-DCN-collective
   — and ``multislice.dcn.load_<tag>`` fires per remote rank-file read
   — ``drop`` declares the transfer failed: the reader writes the DCN
-  abort marker and raises typed instead of burning the timeout).
+  abort marker and raises typed instead of burning the timeout),
+  ``provider`` (the autoscaler's cloud seam, fired through
+  ``fire_site`` so the SITE applies every action:
+  ``autoscaler.provider.launch`` — ``drop`` loses the launch request
+  cloud-side (the instance never appears in ``describe``), ``delay``
+  stretches the boot by the rule's seconds instead of stalling the
+  reconciler — and ``autoscaler.provider.boot`` — ``kill`` makes the
+  node boot and immediately die, the boot-then-die preemption
+  analog, WITHOUT exiting the driver process hosting the provider).
 - ``method``: the RPC method / push topic / task name at the event
   (``reply`` for reply frames; empty for lifecycle points).
 - ``action``: ``drop`` (frame vanishes), ``delay=SECONDS`` (stall),
@@ -107,7 +115,7 @@ KILL_EXIT_CODE = 42
 ACTIONS = ("drop", "delay", "dup", "sever", "kill", "pressure")
 POINTS = ("send", "recv", "dispatch", "spawn", "teardown", "boot",
           "exec", "watchdog", "rendezvous", "checkpoint", "dcn",
-          "map", "*")
+          "map", "provider", "*")
 
 _RULE_RE = re.compile(
     r"^(?P<component>[^.:\s]+)\.(?P<point>[^.:\s]+)\.(?P<method>[^:\s]*)"
@@ -315,6 +323,39 @@ class ChaosPlane:
         (``raylet.watchdog.sample*:pressure=0.97``; the watchdog's
         event method is ``sampleN`` with N = killable-candidate
         count, so ``sample2`` targets exactly-two-victims samples)."""
+        action, arg = self._evaluate(component, point, method)
+        if action is None:
+            return None, 0.0
+        if action == "delay":
+            time.sleep(arg)
+            return None, 0.0
+        if action == "kill":
+            logger.warning("chaos: kill at %s.%s.%s (pid %d)",
+                           component, point, method, os.getpid())
+            # os._exit, not sys.exit: the point is an abrupt death with
+            # no cleanup, finally-blocks, or atexit — the kill -9 analog.
+            os._exit(KILL_EXIT_CODE)
+        logger.warning("chaos: %s at %s.%s.%s", action, component,
+                       point, method)
+        return action, arg
+
+    def fire_site(self, component: str, point: str, method: str = ""
+                  ) -> Tuple[Optional[str], float]:
+        """Like ``fire_arg`` but the SITE applies every action: no
+        inline sleep on ``delay`` and no process exit on ``kill`` —
+        the provider seam simulates the faulted RESOURCE (a slow boot,
+        a node that boots then dies) rather than faulting the control
+        loop's own process."""
+        action, arg = self._evaluate(component, point, method)
+        if action is not None:
+            logger.warning("chaos: %s at %s.%s.%s (site-applied)",
+                           action, component, point, method)
+        return action, arg
+
+    def _evaluate(self, component: str, point: str, method: str
+                  ) -> Tuple[Optional[str], float]:
+        """Rule matching + event/log records, shared by the inline
+        (``fire_arg``) and site-applied (``fire_site``) entries."""
         if not self.armed:
             return None, 0.0
         action = None
@@ -344,17 +385,6 @@ class ChaosPlane:
         self.log_event({"kind": "fire", "component": component,
                         "point": point, "method": method,
                         "action": action, "pid": os.getpid()})
-        if action == "delay":
-            time.sleep(arg)
-            return None, 0.0
-        if action == "kill":
-            logger.warning("chaos: kill at %s.%s.%s (pid %d)",
-                           component, point, method, os.getpid())
-            # os._exit, not sys.exit: the point is an abrupt death with
-            # no cleanup, finally-blocks, or atexit — the kill -9 analog.
-            os._exit(KILL_EXIT_CODE)
-        logger.warning("chaos: %s at %s.%s.%s", action, component,
-                       point, method)
         return action, arg
 
 
@@ -383,6 +413,16 @@ def fire_arg(component: str, point: str, method: str = ""
     if not _plane.armed:
         return None, 0.0
     return _plane.fire_arg(component, point, method)
+
+
+def fire_site(component: str, point: str, method: str = ""
+              ) -> Tuple[Optional[str], float]:
+    """(action, arg) hook entry whose SITE applies every action (no
+    inline delay sleep / kill exit — see ChaosPlane.fire_site); cheap
+    no-op while unarmed."""
+    if not _plane.armed:
+        return None, 0.0
+    return _plane.fire_site(component, point, method)
 
 
 def install(rules: Union[str, Sequence], seed: Optional[int] = None

@@ -367,6 +367,34 @@ _D("actor_checkpoint_keep", int, 2,
    "commit time. At least 1; the restore path falls back one "
    "generation per load failure within whatever is kept.")
 
+# --- cluster autoscaler v2 (docs/autoscaler.md) ---
+_D("autoscaler_upscale_delay_s", float, 0.5,
+   "Sustained unmet-demand pressure required before the reconciler "
+   "queues launches. Direction-stable (mirrors the serve "
+   "autoscaler's): a direction flip resets the timer, so the two "
+   "control loops compose without oscillation.")
+_D("autoscaler_downscale_delay_s", float, 2.0,
+   "Sustained idle pressure (beyond idle_timeout_s) required before "
+   "a drain starts; any unmet demand resets it.")
+_D("autoscaler_request_timeout_s", float, 3.0,
+   "QUEUED->REQUESTED transition deadline: a launch request the "
+   "cloud never acknowledged (chaos 'drop' at "
+   "autoscaler.provider.launch) is declared lost after this long "
+   "and re-launched from the retry budget.")
+_D("autoscaler_allocate_timeout_s", float, 30.0,
+   "REQUESTED->ALLOCATED->RUNNING deadline: an allocation stuck "
+   "pending (or a node that never joins the ray view) is released "
+   "and re-launched from the retry budget.")
+_D("autoscaler_launch_backoff_base_s", float, 0.05,
+   "Seeded-backoff base between re-launch attempts (doubles per "
+   "attempt, jittered; see _private/backoff.py).")
+_D("autoscaler_launch_backoff_cap_s", float, 2.0,
+   "Re-launch backoff ceiling.")
+_D("autoscaler_drain_timeout_s", float, 10.0,
+   "Scale-down drain budget: checkpoint saves + running-lease drain "
+   "+ actor migration must finish inside it or the node is "
+   "uncordoned and kept.")
+
 # --- chaos / fault injection (tests only; see _private/chaos.py) ---
 _D("chaos_rules", str, "",
    "Fault-injection rules (component.point.method:action[...]; "

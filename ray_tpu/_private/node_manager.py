@@ -310,6 +310,16 @@ class NodeManagerGroup:
         self._reply_stats = wire_stats.channel("worker_reply")
         # bumped on node add/remove
         self._membership_version = 0  # guarded-by: _lock
+        # Cordoned nodes (autoscaler drain, docs/autoscaler.md): the
+        # kernel's alive-mask is flipped in cluster_resources, so no
+        # policy places new leases there; this set only remembers
+        # which nodes WE cordoned (vs. genuinely dead) so uncordon
+        # can restore exactly those.
+        self._cordoned: set = set()  # guarded-by: _lock
+        # Node-type catalog (autoscaler-registered): lets
+        # unplaceable_report carry the node-type-feasible view without
+        # the caller re-deriving fit. name -> resources dict.
+        self._node_type_catalog: Dict[str, Dict[str, float]] = {}  # guarded-by: _lock
         # Overload plane, owner side: shed/OOM'd specs wait out their
         # backoff here as (due_monotonic, spec, resubmit) — the
         # scheduling loop pumps due entries back in. RNG seeding
@@ -380,6 +390,7 @@ class NodeManagerGroup:
         """Simulate node death: fail running tasks, drop resources."""
         with self._lock:
             raylet = self._raylets.pop(node_id, None)
+            self._cordoned.discard(node_id)
             if raylet is None:
                 return
             raylet.alive = False
@@ -410,6 +421,60 @@ class NodeManagerGroup:
     def nodes(self) -> List[NodeID]:
         with self._lock:
             return list(self._raylets) + list(self._remote_nodes)
+
+    # -- cordon (autoscaler drain-before-terminate) ------------------------
+
+    def cordon_node(self, node_id: NodeID) -> bool:
+        """No NEW leases on this node: flip its alive-mask bit in the
+        resource ledger (policies + allocate already skip non-alive
+        nodes) without touching running work. The version bump also
+        releases fenced classes so their capacity bound re-derives
+        WITHOUT the cordoned node."""
+        if not self.cluster_resources.set_node_alive(node_id, False):
+            return False
+        with self._lock:
+            self._cordoned.add(node_id)
+        from ray_tpu._private import export
+        export.emit("NODE", {"event": "CORDONED",
+                             "node_id": node_id.hex()})
+        self._wake.set()
+        return True
+
+    def uncordon_node(self, node_id: NodeID) -> bool:
+        """Reopen the node for placement (a drain that failed or was
+        abandoned). Only nodes cordon_node marked are restored — a
+        genuinely dead node's alive bit stays down."""
+        with self._lock:
+            if node_id not in self._cordoned:
+                return False
+            self._cordoned.discard(node_id)
+        self.cluster_resources.set_node_alive(node_id, True)
+        from ray_tpu._private import export
+        export.emit("NODE", {"event": "UNCORDONED",
+                             "node_id": node_id.hex()})
+        self._wake.set()
+        return True
+
+    def is_cordoned(self, node_id: NodeID) -> bool:
+        with self._lock:
+            return node_id in self._cordoned
+
+    def actors_on_node(self, node_id: NodeID) -> List[ActorID]:
+        """Actors currently hosted by this node (the drain worklist)."""
+        with self._lock:
+            return [aid for aid, entry in self._actor_workers.items()
+                    if entry[0] == node_id]
+
+    def running_tasks_on(self, node_id: NodeID) -> int:
+        """In-flight leases on this node (drain waits for zero: a
+        cordon stops NEW leases, running work finishes normally)."""
+        with self._lock:
+            n = sum(1 for rt in self._running.values()
+                    if rt.node_id == node_id)
+            raylet = self._raylets.get(node_id)
+            if raylet is not None:
+                n += len(raylet.dispatch_queue)
+            return n
 
     # -- remote nodes (raylet processes) -----------------------------------
 
@@ -2031,16 +2096,44 @@ class NodeManagerGroup:
                 entry = self._unplaceable.pop(key)
                 self._to_schedule.extend(entry.specs)
 
+    def set_node_type_catalog(
+            self, types: Optional[Dict[str, Dict[str, float]]]) -> None:
+        """Register the autoscaler's node-type catalog (name ->
+        resource totals) so ``unplaceable_report`` can annotate each
+        fenced class with the types that could fit it."""
+        with self._lock:
+            self._node_type_catalog = dict(types or {})
+
+    @staticmethod
+    def _feasible_types(demand: Dict[str, float],
+                        catalog: Dict[str, Dict[str, float]]
+                        ) -> Optional[List[str]]:
+        """Catalog node types whose TOTALS fit one instance of
+        ``demand`` (the node-type-feasible bound: which launches could
+        ever help); None when no catalog is registered — the
+        current-cluster ``bound`` is then the only signal."""
+        if not catalog:
+            return None
+        return [name for name, res in sorted(catalog.items())
+                if all(res.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items())]
+
     def unplaceable_report(self) -> List[dict]:
         """Typed per-class view of everything the cluster cannot
         currently hold, for the owner (autoscaler hints, dashboards,
         tests): capacity-fenced classes (bound > 0 — surplus beyond
         the totals bound) AND totals-infeasible classes (bound == 0 —
         no node could EVER run one instance), each carrying its
-        ``CapacityInfeasibleError``."""
+        ``CapacityInfeasibleError``. With a node-type catalog
+        registered (``set_node_type_catalog``), each entry also
+        carries ``feasible_types`` — the catalog types whose totals
+        fit the shape — so the autoscaler need not re-derive fit."""
         with self._lock:
+            catalog = dict(self._node_type_catalog)
             out = [{"demand": dict(k), "pending": len(e.specs),
-                    "bound": e.error.bound, "error": e.error}
+                    "bound": e.error.bound, "error": e.error,
+                    "feasible_types": self._feasible_types(
+                        e.error.demand, catalog)}
                    for k, e in self._unplaceable.items()]
             infeas: Dict[tuple, int] = {}
             for spec in self._infeasible.values():
@@ -2049,6 +2142,8 @@ class NodeManagerGroup:
         for key, pending in infeas.items():
             out.append({
                 "demand": dict(key), "pending": pending, "bound": 0,
+                "feasible_types": self._feasible_types(dict(key),
+                                                       catalog),
                 "error": CapacityInfeasibleError(
                     f"demand {dict(key)} is infeasible on every node",
                     demand=dict(key), bound=0, pending=pending)})

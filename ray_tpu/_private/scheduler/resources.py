@@ -101,6 +101,21 @@ class ClusterResourceManager:
             self._version += 1
             self._log.append((self._version, node_id, True))
 
+    def set_node_alive(self, node_id: NodeID, alive: bool) -> bool:
+        """Flip the node's alive-mask bit (the scheduler's cordon
+        seam: every policy and ``allocate`` refuse non-alive nodes,
+        so a cordoned node takes no new leases while running work
+        still ``free``s normally). Recorded as a membership change so
+        dense policy views rebuild their row for the node."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or node.alive == alive:
+                return False
+            node.alive = alive
+            self._version += 1
+            self._log.append((self._version, node_id, True))
+            return True
+
     def get_node(self, node_id: NodeID) -> Optional[NodeResources]:
         with self._lock:
             return self._nodes.get(node_id)

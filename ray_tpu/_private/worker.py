@@ -346,6 +346,7 @@ class Worker:
         self.num_ckpt_discarded = 0   # torn/uncommitted/partial drops
         self.ckpt_bytes_total = 0     # bytes across committed saves
         self.last_restore_ms = 0.0
+        self.num_node_drains = 0      # completed drain-before-terminate
         self.node_group._actor_ckpt_cb = self._on_actor_ckpt_saved
         self.node_group._actor_restore_cb = self._on_actor_restore_info
         self._actor_flush_wake = threading.Event()
@@ -2528,6 +2529,123 @@ class Worker:
             self.gcs.update_gang_state(gang_name, "DEAD",
                                        death_cause="member killed")
             self._fence_sliceset_dcn(gang_name, gang_dead=True)
+
+    # ------------------------------------------------------------------
+    # drain-before-terminate (autoscaler scale-down, docs/autoscaler.md)
+
+    def request_actor_checkpoint(self, actor_id: ActorID) -> bool:
+        """Ask the actor's hosting worker for a save-NOW snapshot
+        (same ``__ray_save__`` -> generation -> ``ckpt_saved`` path as
+        the interval autosave). Returns whether the request could be
+        delivered — a remote-raylet actor has no save-now channel and
+        migrates via the restart path instead."""
+        w = self.node_group.actor_worker(actor_id)
+        if w is None:
+            return False
+        try:
+            w.send(("ckpt_save", actor_id.binary()))
+        except Exception:
+            return False    # remote route / worker already dead
+        return True
+
+    def migrate_actor(self, actor_id: ActorID,
+                      idle_deadline: Optional[float] = None) -> bool:
+        """Move one actor off its node through the restart/restore
+        taxonomy WITHOUT consuming its restart budget (the move is
+        voluntary, not a fault): mark RESTARTING so the flusher stops
+        dispatching new calls, wait for in-flight calls to finish,
+        then release the worker and resubmit the creation spec — the
+        scheduler places it on a non-cordoned node and restore-before-
+        replay reloads the newest committed checkpoint."""
+        from ray_tpu._private import export
+        with self._actor_lock:
+            creation = self._actor_specs.get(actor_id)
+            tombstoned = actor_id in self._actor_tombstones
+        if creation is None or tombstoned:
+            return False
+        self.gcs.update_actor_state(actor_id, "RESTARTING")
+        export.emit("ACTOR", {"actor_id": actor_id.hex(),
+                              "state": "RESTARTING", "cause": "migrate"})
+        deadline = idle_deadline if idle_deadline is not None \
+            else time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self.node_group._lock:
+                busy = any(rt.spec.task_type == TaskType.ACTOR_TASK
+                           and rt.spec.actor_id == actor_id
+                           for rt in self.node_group._running.values())
+            if not busy:
+                break
+            time.sleep(0.01)
+        self.node_group.release_actor(actor_id, kill_worker=True)
+        self.task_manager.add_pending_task(creation)
+        self.node_group.submit_task(creation)
+        return True
+
+    def drain_node(self, node_id: NodeID,
+                   timeout_s: float = 10.0) -> Tuple[bool, str]:
+        """Two-phase scale-down drain: (1) cordon — the scheduler's
+        alive-mask refuses new leases; (2) checkpoint + migrate every
+        hosted actor and wait for running leases to finish; only then
+        may the caller terminate the instance. Any refusal uncordons
+        and reports why — the node keeps running. A chaos kill
+        mid-drain is ordinary actor death: the restart/restore
+        taxonomy replays from the newest COMMITTED generation, so no
+        checkpointed state is lost."""
+        ng = self.node_group
+        if not ng.cordon_node(node_id):
+            return False, "unknown node or cordon refused"
+        deadline = time.monotonic() + timeout_s
+        actors = ng.actors_on_node(node_id)
+        # refuse non-drainable hosts up front, before disturbing state
+        for aid in actors:
+            with self._gang_lock:
+                gang = self._actor_gang.get(aid)
+            if gang is not None:
+                ng.uncordon_node(node_id)
+                return False, (f"actor {aid.hex()[:8]} is a member of "
+                               f"gang {gang}: gang migration is a "
+                               "coordinated restart, not a drain")
+            with self._actor_lock:
+                restarts = self._actor_restarts.get(aid, 0)
+                creation = self._actor_specs.get(aid)
+            checkpointable = (
+                creation is not None and creation.checkpoint_interval > 0
+                or self.gcs.get_checkpoint(aid) is not None)
+            if creation is None or (restarts == 0 and not checkpointable):
+                ng.uncordon_node(node_id)
+                return False, (f"actor {aid.hex()[:8]} is neither "
+                               "restartable nor checkpointable: "
+                               "terminating would destroy its state")
+        # phase 1: save-now; wait for each commit marker to land (the
+        # owner-side commit is what makes the generation restorable)
+        waiting: Dict[ActorID, int] = {}
+        for aid in actors:
+            before = self.gcs.get_checkpoint(aid)
+            with self._actor_lock:
+                creation = self._actor_specs.get(aid)
+            if creation is not None and creation.checkpoint_interval > 0 \
+                    or before is not None:
+                if self.request_actor_checkpoint(aid):
+                    waiting[aid] = before.gen if before else 0
+        for aid, gen0 in waiting.items():
+            while time.monotonic() < deadline:
+                info = self.gcs.get_checkpoint(aid)
+                if info is not None and info.gen > gen0:
+                    break
+                time.sleep(0.02)
+        # phase 2: running leases finish (cordon stops new ones)
+        while time.monotonic() < deadline:
+            if ng.running_tasks_on(node_id) == 0:
+                break
+            time.sleep(0.02)
+        if ng.running_tasks_on(node_id) != 0:
+            ng.uncordon_node(node_id)
+            return False, "running leases did not drain in time"
+        # phase 3: migrate — restart/restore without burning budget
+        for aid in actors:
+            self.migrate_actor(aid, idle_deadline=deadline)
+        self.num_node_drains += 1
+        return True, ""
 
     # ------------------------------------------------------------------
     # lifecycle

@@ -196,6 +196,13 @@ class InProcessWorker(BaseWorker):
                 self.env.exec_templates[msg[1]] = msg[2]
             elif op == "cancel_actor_task":
                 self.env.cancel_actor_task(msg[1], msg[2])
+            elif op == "ckpt_save":
+                # save-NOW (autoscaler drain) — see worker_process
+                try:
+                    self.env.save_actor_checkpoint(msg[1], send)
+                except Exception:
+                    pass    # non-checkpointable actor: owner poll
+                            # times out and the restart path migrates
             elif op in ("exec", "create_actor", "exec_actor",
                         "exec_actor_batch"):
                 try:

@@ -1346,6 +1346,14 @@ def worker_main(conn, session: str, max_inline_bytes: int,
                         # (reply sent), a late signal must find NO
                         # current task, not the finished one's id
                         _TASK_FALLBACK["task_id"] = b""
+            elif op == "ckpt_save":
+                # save-NOW (autoscaler drain): same snapshot + commit
+                # path as the interval autosave; a non-checkpointable
+                # actor is a no-op and the owner's commit poll times out
+                try:
+                    env.save_actor_checkpoint(msg[1], send)
+                except Exception:
+                    logger.exception("ckpt_save failed")
             elif op == "core_addr":
                 # Compiled-DAG channel binding: report this process's
                 # owner-core address (creates the core on first ask).

@@ -54,7 +54,7 @@ from ray_tpu.devtools.analysis.core import (FileContext, attr_tail,
 
 # Bump to invalidate every cached summary (core folds this into the
 # cache version tag alongside the per-pass versions).
-SUMMARY_VERSION = 3
+SUMMARY_VERSION = 4
 
 # A with-item / lock-arg is considered lock-like when its defining
 # class marks it as a lock, or (fallback for files whose __init__ was
@@ -75,7 +75,7 @@ _BLOCKING_OK_RE = re.compile(r"blocking-ok:\s*(.*)")
 _SELF_FIELD_RE = re.compile(r"self\.(\w+)\s*[:=\[]")
 _MODULE_FIELD_RE = re.compile(r"^(\w+)\s*[:=\[]")
 
-_CHAOS_METHODS = {"fire", "fire_arg"}
+_CHAOS_METHODS = {"fire", "fire_arg", "fire_site"}
 
 _CHAOS_UNREACHABLE_MARK = "chaos-unreachable:"
 _SWALLOW_OK_MARK = "swallow-ok:"

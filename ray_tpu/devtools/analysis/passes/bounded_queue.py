@@ -30,10 +30,10 @@ from ray_tpu.devtools.analysis.core import (FileContext, Finding,
                                             suppressed_by_mark)
 
 PASS_ID = "bounded-queue"
-VERSION = 8   # v8: streaming data plane (ray_tpu/data/)
+VERSION = 9   # v9: cluster autoscaler (ray_tpu/autoscaler/)
 
 _SCOPES = ("_private/", "collective/", "multislice/",
-           "serve/", "data/", "analysis_fixtures/")
+           "serve/", "data/", "autoscaler/", "analysis_fixtures/")
 
 _SUPPRESS_MARK = "unbounded-ok:"
 

@@ -42,10 +42,10 @@ from typing import Dict, List, Tuple
 from ray_tpu.devtools.analysis.core import Finding
 
 PASS_ID = "chaos-coverage"
-VERSION = 2
+VERSION = 3   # v3: cluster autoscaler (ray_tpu/autoscaler/)
 
 _SCOPES = ("_private/", "collective/", "multislice/", "serve/",
-           "data/", "analysis_fixtures/")
+           "data/", "autoscaler/", "analysis_fixtures/")
 
 
 def _in_scope(path: str) -> bool:

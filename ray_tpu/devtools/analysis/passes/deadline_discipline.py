@@ -33,10 +33,10 @@ from typing import List
 from ray_tpu.devtools.analysis.core import FileContext, Finding, attr_tail
 
 PASS_ID = "deadline-discipline"
-VERSION = 6   # v6: streaming data plane (ray_tpu/data/)
+VERSION = 7   # v7: cluster autoscaler (ray_tpu/autoscaler/)
 
 _SCOPES = ("_private/", "collective/", "multislice/",
-           "serve/", "data/", "analysis_fixtures/")
+           "serve/", "data/", "autoscaler/", "analysis_fixtures/")
 
 _SUPPRESS_MARK = "no-deadline:"
 

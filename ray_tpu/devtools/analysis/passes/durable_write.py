@@ -37,10 +37,10 @@ from ray_tpu.devtools.analysis.core import (FileContext, Finding,
                                             suppressed_by_mark)
 
 PASS_ID = "durable-write"
-VERSION = 4   # v4: streaming data plane (ray_tpu/data/)
+VERSION = 5   # v5: cluster autoscaler (ray_tpu/autoscaler/)
 
 _SCOPES = ("_private/", "train/", "multislice/",
-           "serve/", "data/", "analysis_fixtures/")
+           "serve/", "data/", "autoscaler/", "analysis_fixtures/")
 _EXEMPT_FILES = ("_private/durable.py",)
 
 _SUPPRESS_MARK = "non-durable-ok:"

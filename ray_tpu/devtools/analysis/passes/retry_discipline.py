@@ -29,13 +29,13 @@ from typing import List
 from ray_tpu.devtools.analysis.core import FileContext, Finding
 
 PASS_ID = "retry-discipline"
-VERSION = 7   # v7: streaming data plane (ray_tpu/data/)
+VERSION = 8   # v8: cluster autoscaler (ray_tpu/autoscaler/)
 
 # Enforced scopes: the runtime core, the collective/gang plane, plus
 # the lint fixture tree (the self-test floor in
 # tests/analysis_fixtures/).
 _SCOPES = ("_private/", "collective/", "multislice/",
-           "serve/", "data/", "analysis_fixtures/")
+           "serve/", "data/", "autoscaler/", "analysis_fixtures/")
 
 _SUPPRESS_MARK = "no-deadline:"
 

@@ -210,6 +210,26 @@ class CapacityInfeasibleError(SystemOverloadError):
                              self.demand, self.bound, self.pending))
 
 
+class UnsatisfiableDemandError(RayTpuError):
+    """A demand shape fits NO node type in the autoscaler's catalog:
+    no amount of scale-up can ever place it. Distinct from
+    CapacityInfeasibleError (whose bound can rise as nodes join) —
+    this one is terminal for the shape until the catalog itself
+    changes, so the autoscaler records it typed instead of launching
+    nodes that could never help (docs/autoscaler.md)."""
+
+    def __init__(self, msg: str = "demand fits no catalog node type",
+                 demand: Optional[dict] = None,
+                 node_types: Optional[list] = None):
+        super().__init__(msg)
+        self.demand = dict(demand or {})
+        self.node_types = list(node_types or [])
+
+    def __reduce__(self):
+        return (type(self), (self.args[0] if self.args else "",
+                             self.demand, self.node_types))
+
+
 class CollectiveAbortError(RayTpuError):
     """A collective group was aborted mid-operation: a member died (or
     the gang's epoch was fenced off) while this rank was inside a

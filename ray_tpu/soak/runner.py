@@ -37,8 +37,9 @@ from ray_tpu.soak import oracle
 from ray_tpu.soak.schedule import (Schedule, fault_log_digest,
                                    generate_schedule)
 from ray_tpu.soak.workloads import (ChurnDriver, IngressDriver,
-                                    TrainerDriver, build_serve_apps,
-                                    serve_chaos_arm, serve_chaos_disarm)
+                                    ScaleDriver, TrainerDriver,
+                                    build_serve_apps, serve_chaos_arm,
+                                    serve_chaos_disarm)
 
 
 @dataclasses.dataclass
@@ -90,7 +91,7 @@ class SoakRunner:
         chaos.log_event(self.schedule.header_record())
 
         cluster = None
-        ingress = trainer = churn = None
+        ingress = trainer = churn = scale = None
         try:
             cluster = self._bring_up()
             # trainer first: its two slice workers claim head pool
@@ -102,12 +103,23 @@ class SoakRunner:
             ingress = IngressDriver(period_s=cfg.http_period_s).start()
             churn = ChurnDriver(ledger_dir, arm_dir)
             churn.start()
+            # the autoscaling lane: ELASTIC bursts that only complete
+            # if the v2 scaler supplies (and later drains) capacity
+            scale = ScaleDriver(cluster).start()
 
             time.sleep(cfg.warmup_s)        # calm p99 baseline window
             ingress.calm = False
             self._run_phases(ingress, trainer, churn, deployments)
-            return self._finish(ingress, trainer, churn, deployments)
+            return self._finish(ingress, trainer, churn, scale,
+                                deployments)
         finally:
+            if scale is not None:
+                try:
+                    scale.stop()
+                    scale.join(timeout=90)
+                    scale.shutdown_scaler()
+                except Exception:
+                    pass    # teardown best effort
             for drv in (ingress, churn, trainer):
                 try:
                     if drv is not None:
@@ -184,7 +196,10 @@ class SoakRunner:
         thunk. Arm failures degrade to a no-op phase (recorded in the
         timeline either way — the digest is about the SCHEDULE, not
         about every fault landing)."""
-        if ph.scope == "driver":
+        if ph.scope in ("driver", "autoscaler"):
+            # autoscaler-scope provider points are site-applied in the
+            # driver process (FakeCloudProvider lives here), so the
+            # same install_phase route reaches them
             chaos.install_phase(ph.name, ph.rules)
             return lambda: chaos.clear_phase(ph.name)
         if ph.scope == "churn":
@@ -218,13 +233,16 @@ class SoakRunner:
 
     # -- verdict ------------------------------------------------------
 
-    def _finish(self, ingress, trainer, churn,
+    def _finish(self, ingress, trainer, churn, scale,
                 deployments) -> oracle.SoakVerdict:
         cfg = self.cfg
         ingress.stop()
         churn.stop()
         churn.join(timeout=60)
         churn.sweep()
+        scale.stop()
+        scale.join(timeout=90)      # a burst mid-relaunch rides out
+        scale.shutdown_scaler()
         trainer.stop()
         trainer.join(timeout=180)
 
@@ -237,7 +255,7 @@ class SoakRunner:
         inv: List[oracle.InvariantResult] = []
 
         lost = (list(ingress.lost) + list(churn.lost)
-                + list(trainer.failures))
+                + list(trainer.failures) + list(scale.lost))
         inv.append(oracle.InvariantResult(
             "no-lost-results", not lost,
             "; ".join(lost[:5]) + (" …" if len(lost) > 5 else "")))
@@ -274,7 +292,7 @@ class SoakRunner:
                                     f"schedule {want[:12]}"))
 
         counts: Dict[str, float] = {}
-        for drv in (ingress, trainer, churn):
+        for drv in (ingress, trainer, churn, scale):
             counts.update(drv.stats())
         counts["fires"] = self._count_fires()
         counts["phases"] = len(self.schedule.phases)

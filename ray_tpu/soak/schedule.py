@@ -24,6 +24,9 @@ A **schedule** is a deterministic function of ``(seed, duration)``:
   ``trainer`` the TrainerDriver arms ALL ranks at the next epoch
               boundary — the real rule on the victim, an ``@999``
               placeholder on peers for checkpoint call symmetry
+  ``autoscaler``  ``chaos.install_phase()`` in the driver, like
+              ``driver`` — the FakeCloudProvider's site-applied
+              ``provider`` points live in the driver process
   ==========  =====================================================
 
 The **weight table** below is the draw distribution. Every entry
@@ -50,7 +53,7 @@ import json
 import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
-SCHEDULE_VERSION = 1
+SCHEDULE_VERSION = 2   # v2: autoscaler scope (provider chaos)
 
 # record kinds covered by the replay digest (logical timeline only)
 DIGEST_KINDS = frozenset({"schedule", "arm", "disarm"})
@@ -102,6 +105,18 @@ WEIGHTS: Tuple[ArmSpec, ...] = (
             "collective.rendezvous.save_*:kill@1", "trainer", 1.0),
     ArmSpec("actor.checkpoint.save",
             "actor.checkpoint.save:kill@{after}", "trainer", 1.0),
+    # -- autoscaler scope: provider faults (site-applied, armed via
+    # install_phase in the driver — the FakeCloudProvider lives there;
+    # docs/autoscaler.md). A dropped launch must converge through the
+    # REQUESTED deadline + retry budget; boot-then-die through the
+    # `gone` observation.
+    ArmSpec("autoscaler.provider.launch",
+            "autoscaler.provider.launch:drop@{after}", "autoscaler", 2.0),
+    ArmSpec("autoscaler.provider.launch",
+            "autoscaler.provider.launch:delay=0.2@{after}",
+            "autoscaler", 1.0),
+    ArmSpec("autoscaler.provider.boot",
+            "autoscaler.provider.boot:kill@{after}", "autoscaler", 1.0),
 )
 
 # boot-scope pool: armed once in the remote raylet's environment at

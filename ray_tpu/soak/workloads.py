@@ -790,3 +790,83 @@ class ChurnDriver(threading.Thread):
         return {"churn_tasks_ok": self.tasks_ok,
                 "churn_actors_ok": self.actors_ok,
                 "churn_lost": len(self.lost)}
+
+
+# ---------------------------------------------------------------------------
+# autoscaling lane
+
+
+@ray_tpu.remote(num_cpus=0, resources={"ELASTIC": 1}, max_retries=5)
+def elastic_task(tag: str):
+    return tag
+
+
+class ScaleDriver(threading.Thread):
+    """The autoscaling lane (docs/autoscaler.md): bursts of tasks
+    demanding an ELASTIC resource NO base node carries, so every burst
+    saturates past capacity, parks totals-infeasible, and completes
+    only if the v2 autoscaler actually launches an elastic node and
+    the parked work un-fences. The lane's chaos scope arms
+    ``autoscaler.provider.launch`` / ``autoscaler.provider.boot``
+    rules in the driver (the provider lives here), so lost launches
+    and boot-then-die instances must converge through the retry
+    budget for bursts to keep landing. Short idle/downscale timers
+    make the elastic node drain-and-terminate between bursts,
+    exercising the scale-down path every cycle."""
+
+    def __init__(self, cluster, burst: int = 3):
+        super().__init__(daemon=True, name="soak-scale")
+        from ray_tpu.autoscaler import NodeType
+        from ray_tpu.autoscaler.v2 import AutoscalerV2, FakeCloudProvider
+        self.burst = burst
+        self.provider = FakeCloudProvider(cluster, boot_delay_s=0.05)
+        self.scaler = AutoscalerV2(
+            self.provider,
+            [NodeType("elastic", {"CPU": 2, "ELASTIC": 4},
+                      max_workers=2)],
+            idle_timeout_s=0.5, period_s=0.1, max_launch_attempts=8,
+            upscale_delay_s=0.1, downscale_delay_s=0.5,
+            request_timeout_s=0.5, allocate_timeout_s=5.0)
+        self.bursts_ok = 0
+        self.tasks_ok = 0
+        self.lost: List[str] = []
+        self._halt = threading.Event()
+
+    def start(self) -> "ScaleDriver":
+        self.scaler.start()
+        super().start()
+        return self
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def run(self) -> None:
+        cycle = 0
+        while not self._halt.is_set():
+            cycle += 1
+            tags = [f"s{cycle:04d}-{i}" for i in range(self.burst)]
+            refs = [elastic_task.remote(t) for t in tags]
+            try:
+                # generous bound: a burst rides out lost launches and
+                # boot-then-die relaunches, but a burst that NEVER
+                # un-fences is a lost result, not a hang
+                vals = ray_tpu.get(refs, timeout=60)
+                if vals == tags:
+                    self.bursts_ok += 1
+                    self.tasks_ok += len(tags)
+                else:
+                    self.lost.append(
+                        f"scale burst {cycle}: wrong returns {vals!r}")
+            except Exception as e:
+                self.lost.append(f"scale burst {cycle}: {e!r}")
+            self._halt.wait(1.0)
+
+    def shutdown_scaler(self) -> None:
+        self.scaler.stop()
+
+    def stats(self) -> Dict[str, float]:
+        return {"scale_bursts_ok": self.bursts_ok,
+                "scale_tasks_ok": self.tasks_ok,
+                "scale_launch_retries": self.scaler.num_launch_retries,
+                "scale_drains": self.scaler.num_drains,
+                "scale_lost": len(self.lost)}

@@ -112,3 +112,365 @@ def test_v2_idle_termination(ray_start_cluster):
             nid for nid, _ in w.node_group.cluster_resources.nodes()}
     finally:
         scaler.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos-hardened provisioning (docs/autoscaler.md)
+
+
+def test_v2_chaos_dropped_launch_converges(ray_start_cluster):
+    """A launch lost cloud-side (chaos `drop` at the provider seam:
+    the id never appears in describe) is only detectable by the
+    REQUESTED deadline — the reconciler must requeue under backoff and
+    converge to RUNNING within the retry budget."""
+    from ray_tpu._private import chaos
+    cluster = ray_start_cluster
+    provider = FakeCloudProvider(cluster, boot_delay_s=0.05)
+    scaler = AutoscalerV2(
+        provider, [NodeType("t", {"CPU": 1, "ELASTICA": 1},
+                            max_workers=1)],
+        idle_timeout_s=60, period_s=0.05, max_launch_attempts=5,
+        upscale_delay_s=0.05, request_timeout_s=0.4).start()
+    try:
+        chaos.install("autoscaler.provider.launch:drop@1")
+
+        @ray_tpu.remote(resources={"ELASTICA": 1})
+        def f():
+            return "ok"
+
+        assert ray_tpu.get(f.remote(), timeout=60) == "ok"
+        running = [i for i in scaler.instances.all()
+                   if i.state == InstanceState.RUNNING]
+        assert running, scaler.instances.table()
+        # the dropped launch burned one attempt; convergence took >= 2
+        assert running[0].launch_attempts >= 2
+        assert scaler.num_launch_retries >= 1
+    finally:
+        chaos.clear()
+        scaler.stop()
+
+
+def test_v2_chaos_boot_then_die_converges(ray_start_cluster):
+    """Boot-then-die (chaos `kill` at the boot point: the node joins
+    and immediately dies, the allocation reports `gone`) re-launches
+    from the retry budget and converges to RUNNING."""
+    from ray_tpu._private import chaos
+    cluster = ray_start_cluster
+    provider = FakeCloudProvider(cluster, boot_delay_s=0.05)
+    scaler = AutoscalerV2(
+        provider, [NodeType("t", {"CPU": 1, "ELASTICB": 1},
+                            max_workers=1)],
+        idle_timeout_s=60, period_s=0.05, max_launch_attempts=5,
+        upscale_delay_s=0.05).start()
+    try:
+        chaos.install("autoscaler.provider.boot:kill@1")
+
+        @ray_tpu.remote(resources={"ELASTICB": 1}, max_retries=5)
+        def f():
+            return "ok"
+
+        assert ray_tpu.get(f.remote(), timeout=60) == "ok"
+        running = [i for i in scaler.instances.all()
+                   if i.state == InstanceState.RUNNING]
+        assert running, scaler.instances.table()
+        assert running[0].launch_attempts >= 2
+        assert scaler.num_launch_retries >= 1
+    finally:
+        chaos.clear()
+        scaler.stop()
+
+
+# ---------------------------------------------------------------------------
+# typed, gang-granular demand
+
+
+def test_v2_parked_tpu_gang_unfences_after_scale_up():
+    """Acceptance: a PACK'd 8-TPU placement group parks on a TPU-less
+    head, the scaler reads the cohort as ONE slice-granular shape,
+    launches one slice-shaped node, and every gang task completes —
+    zero lost tasks."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+    cluster = Cluster(head_num_cpus=4, num_tpus=0)
+    scaler = None
+    try:
+        provider = FakeCloudProvider(cluster, boot_delay_s=0.05)
+        scaler = AutoscalerV2(
+            provider,
+            [NodeType("slice", {"CPU": 4, "TPU": 8}, max_workers=1)],
+            idle_timeout_s=60, period_s=0.05,
+            upscale_delay_s=0.05).start()
+        pg = placement_group([{"TPU": 1}] * 8, strategy="PACK")
+
+        @ray_tpu.remote(num_cpus=0, num_tpus=1)
+        def rank_task(i):
+            return i
+
+        refs = [rank_task.options(
+                    placement_group=pg,
+                    placement_group_bundle_index=i).remote(i)
+                for i in range(8)]
+        assert ray_tpu.get(refs, timeout=60) == list(range(8))
+        # ONE slice-shaped node, not eight stray launches
+        launched = [i for i in scaler.instances.all()
+                    if i.state == InstanceState.RUNNING]
+        assert len(launched) == 1, scaler.instances.table()
+        assert launched[0].node_type == "slice"
+        remove_placement_group(pg)
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        cluster.shutdown()
+
+
+def test_v2_unsatisfiable_demand_is_typed(ray_start_cluster):
+    """A shape NO catalog type can ever fit becomes a typed
+    UnsatisfiableDemandError — recorded, excluded from launch
+    pressure, and never a launch loop."""
+    from ray_tpu.exceptions import UnsatisfiableDemandError
+    cluster = ray_start_cluster
+    provider = FakeCloudProvider(cluster)
+    scaler = AutoscalerV2(
+        provider, [NodeType("t", {"CPU": 2}, max_workers=2)],
+        idle_timeout_s=60, period_s=0.05, upscale_delay_s=0.0,
+        worker=cluster._worker)
+
+    @ray_tpu.remote(resources={"ANTIMATTER": 1})
+    def f():
+        return 1
+
+    f.remote()      # parks: no node (and no catalog type) fits
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not scaler.unsatisfiable:
+        scaler.reconcile_once()
+        time.sleep(0.05)
+    assert scaler.unsatisfiable, "shape never recorded unsatisfiable"
+    err = next(iter(scaler.unsatisfiable.values()))
+    assert isinstance(err, UnsatisfiableDemandError)
+    assert err.demand.get("ANTIMATTER") == 1
+    assert err.node_types == ["t"]
+    # no instance was ever minted for it
+    assert scaler.instances.all() == []
+
+
+def test_v2_unplaceable_report_carries_feasible_types(
+        ray_start_cluster):
+    """Satellite: with a registered catalog, unplaceable_report
+    entries state WHICH node types could fit each parked class (the
+    CapacityInfeasibleError plumbing itself is untouched)."""
+    cluster = ray_start_cluster
+    w = cluster._worker
+    provider = FakeCloudProvider(cluster)
+    scaler = AutoscalerV2(
+        provider,
+        [NodeType("small", {"CPU": 2}, max_workers=1),
+         NodeType("big", {"CPU": 2, "WIDE": 4}, max_workers=1)],
+        idle_timeout_s=60, period_s=0.05,
+        # upscale gate held shut: this test reads the REPORT, the
+        # demand must stay parked
+        upscale_delay_s=3600, worker=w)
+    assert scaler is not None
+
+    @ray_tpu.remote(resources={"WIDE": 2})
+    def f():
+        return 1
+
+    f.remote()
+    entry = _wait(lambda: [e for e in w.node_group.unplaceable_report()
+                           if "WIDE" in e["demand"]])
+    assert entry, w.node_group.unplaceable_report()
+    assert entry[0]["feasible_types"] == ["big"]
+
+
+# ---------------------------------------------------------------------------
+# drain-before-terminate scale-down
+
+
+def test_v2_scale_down_drains_checkpointed_actor(ray_start_cluster):
+    """Acceptance: scale-down of a node hosting a checkpointable
+    actor cordons it, saves through the checkpoint plane, migrates
+    the actor (restore included), and only then terminates — zero
+    lost actor state, and the voluntary move consumes no restart
+    budget."""
+    cluster = ray_start_cluster
+    provider = FakeCloudProvider(cluster, boot_delay_s=0.05)
+    scaler = AutoscalerV2(
+        provider,
+        [NodeType("pool", {"CPU": 2, "POOL": 2}, max_workers=2)],
+        idle_timeout_s=0.6, period_s=0.05, upscale_delay_s=0.05,
+        downscale_delay_s=0.3, drain_timeout_s=15.0).start()
+    try:
+        @ray_tpu.remote(num_cpus=0, resources={"POOL": 1},
+                        max_restarts=1, max_task_retries=2,
+                        checkpoint_interval=1)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+            def __ray_save__(self):
+                return {"n": self.n}
+
+            def __ray_restore__(self, state):
+                self.n = state["n"]
+
+        a = Counter.remote()      # parks until the scaler supplies POOL
+        for expect in (1, 2, 3):
+            assert ray_tpu.get(a.bump.remote(), timeout=60) == expect
+        # go idle: the scaler drains the pool node (cordon ->
+        # checkpoint -> migrate -> terminate); the resubmitted actor
+        # parks again and a FRESH instance hosts the restore
+        drained = _wait(lambda: scaler.num_drains >= 1, timeout=60)
+        assert drained, scaler.report()
+        # state survived the migration: the counter resumes at 4
+        assert ray_tpu.get(a.bump.remote(), timeout=60) == 4
+        # the drained instance terminated and a fresh one re-hosted
+        # the actor (the migration round-trip, not an in-place no-op)
+        terminated = [i for i in scaler.instances.all()
+                      if i.state == InstanceState.TERMINATED]
+        assert terminated, scaler.instances.table()
+        assert len(scaler.instances.all()) >= 2, \
+            scaler.instances.table()
+    finally:
+        scaler.stop()
+
+
+def test_v2_chaos_kill_mid_drain_loses_no_state(ray_start_cluster):
+    """Acceptance: a chaos kill landing DURING the drain (the save-now
+    snapshot dies mid-write) surfaces through the existing
+    restart/restore taxonomy — the drain refuses (node kept), the
+    actor restarts from its last committed generation, and no state
+    is lost."""
+    from ray_tpu._private import chaos
+    cluster = ray_start_cluster
+    provider = FakeCloudProvider(cluster, boot_delay_s=0.05)
+    scaler = AutoscalerV2(
+        provider,
+        [NodeType("pool", {"CPU": 2, "POOLK": 2}, max_workers=2)],
+        idle_timeout_s=0.6, period_s=0.05, upscale_delay_s=0.05,
+        downscale_delay_s=0.3, drain_timeout_s=4.0).start()
+    try:
+        @ray_tpu.remote(num_cpus=0, resources={"POOLK": 1},
+                        max_restarts=2, max_task_retries=2,
+                        checkpoint_interval=1)
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+            def __ray_save__(self):
+                return {"n": self.n}
+
+            def __ray_restore__(self, state):
+                self.n = state["n"]
+
+        a = Counter.remote()
+        for expect in (1, 2):
+            assert ray_tpu.get(a.bump.remote(), timeout=60) == expect
+        # the NEXT save (the drain's save-now) dies mid-write: a torn
+        # generation that must never commit
+        chaos.install("actor.checkpoint.save:kill@1")
+        # wait out at least one drain attempt window
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if any(i.state == InstanceState.TERMINATING
+                   for i in scaler.instances.all()) \
+                    or scaler.num_drains >= 1:
+                break
+            time.sleep(0.05)
+        # whether the drain refused (kept node) or a later attempt
+        # succeeded from the last committed generation, the counter's
+        # history is intact: no double-applied and no lost bumps
+        assert ray_tpu.get(a.bump.remote(), timeout=60) == 3
+        assert ray_tpu.get(a.bump.remote(), timeout=60) == 4
+    finally:
+        chaos.clear()
+        scaler.stop()
+
+
+# ---------------------------------------------------------------------------
+# composition: serve autoscaler x cluster autoscaler
+
+
+def test_v2_anti_oscillation_composition(ray_start_cluster):
+    """Satellite: under a sustained step load, the serve autoscaler
+    (replica counts) and the cluster autoscaler (instance counts)
+    compose without oscillation — both series are monotone
+    non-decreasing for the whole load window (direction-stable delays
+    on both loops), polled against a deadline."""
+    from ray_tpu import serve
+    cluster = ray_start_cluster
+    provider = FakeCloudProvider(cluster, boot_delay_s=0.05)
+    scaler = AutoscalerV2(
+        provider,
+        [NodeType("pool", {"CPU": 2, "STEP": 2}, max_workers=3)],
+        idle_timeout_s=30.0, period_s=0.05, upscale_delay_s=0.2,
+        downscale_delay_s=30.0).start()
+    try:
+        @serve.deployment(autoscaling_config={
+            "min_replicas": 1, "max_replicas": 3,
+            "target_ongoing_requests": 1.0,
+            "upscale_delay_s": 0.2, "downscale_delay_s": 30.0})
+        class Slow:
+            def __call__(self, x):
+                time.sleep(0.3)
+                return x
+
+        handle = serve.run(Slow.bind())
+
+        @ray_tpu.remote(num_cpus=0, resources={"STEP": 1},
+                        max_retries=5)
+        def step_task(i):
+            time.sleep(0.2)
+            return i
+
+        # step load: serve flood + a standing stream of STEP tasks
+        serve_refs = [handle.remote(i) for i in range(10)]
+        task_refs = [step_task.remote(i) for i in range(8)]
+
+        # Sample until both loops have visibly scaled, then keep
+        # watching for one more second to catch any flap; hard cap
+        # keeps the test inside the tier-1 deadline either way.
+        replica_series = []
+        instance_series = []
+        hard_deadline = time.monotonic() + 8.0
+        scaled_at = None
+        while time.monotonic() < hard_deadline:
+            replica_series.append(
+                serve.status()["Slow"]["live_replicas"])
+            instance_series.append(len([
+                i for i in scaler.instances.all()
+                if i.state == InstanceState.RUNNING]))
+            now = time.monotonic()
+            if (scaled_at is None and max(instance_series) >= 1
+                    and max(replica_series) >= 2):
+                scaled_at = now
+            if scaled_at is not None and now - scaled_at >= 1.0:
+                break
+            time.sleep(0.1)
+
+        ray_tpu.get(serve_refs, timeout=120)
+        ray_tpu.get(task_refs, timeout=120)
+
+        # both loops actually scaled...
+        assert max(instance_series) >= 1, instance_series
+        assert max(replica_series) >= 2, replica_series
+        # ...and neither flapped: monotone non-decreasing under load
+        for name, series in (("replicas", replica_series),
+                             ("instances", instance_series)):
+            for a, b in zip(series, series[1:]):
+                assert b >= a, f"{name} oscillated: {series}"
+    finally:
+        try:
+            from ray_tpu import serve
+            serve.shutdown()
+        except Exception:
+            pass
+        scaler.stop()

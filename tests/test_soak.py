@@ -20,11 +20,11 @@ from ray_tpu.soak.schedule import (DIGEST_KINDS, fault_log_digest,
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # The smoke's pinned draw: at duration 14 this seed's schedule covers
-# all four live scopes (churn, serve, driver, trainer) — verified by
-# test_smoke_seed_covers_every_scope so a weight-table edit that
-# breaks the property fails loudly instead of silently shrinking
-# coverage.
-SMOKE_SEED = 14
+# all five live scopes (churn, serve, driver, trainer, autoscaler) —
+# verified by test_smoke_seed_covers_every_scope so a weight-table
+# edit that breaks the property fails loudly instead of silently
+# shrinking coverage.
+SMOKE_SEED = 63
 SMOKE_DURATION = 14.0
 
 
@@ -79,7 +79,8 @@ def test_every_drawable_rule_parses_and_scopes_are_valid():
         assert sched.phases, "schedule drew no phases"
         assert sched.phases[0].scope == "churn"     # anchor phase
         for ph in sched.phases:
-            assert ph.scope in ("driver", "churn", "serve", "trainer")
+            assert ph.scope in ("driver", "churn", "serve",
+                                "trainer", "autoscaler")
             for rule in ph.rules:
                 ChaosRule.parse(rule)
 
@@ -87,7 +88,8 @@ def test_every_drawable_rule_parses_and_scopes_are_valid():
 def test_smoke_seed_covers_every_scope():
     scopes = {ph.scope for ph in
               generate_schedule(SMOKE_SEED, SMOKE_DURATION).phases}
-    assert scopes == {"churn", "serve", "driver", "trainer"}
+    assert scopes == {"churn", "serve", "driver", "trainer",
+                      "autoscaler"}
 
 
 def test_cli_dry_run_prints_timeline_and_digest(tmp_path):
@@ -202,10 +204,13 @@ def test_soak_smoke_all_invariants_hold(tmp_path):
     # truth (at minimum the anchor churn kill + the boot-armed rules)
     assert verdict["counts"]["fires"] >= 1
     assert verdict["counts"]["phases"] >= 3
-    # all three lanes did real work
+    # all four lanes did real work; scale bursts completing proves
+    # parked ELASTIC work un-fenced after the v2 scaler supplied
+    # capacity (docs/autoscaler.md)
     assert verdict["counts"]["ingress_ok"] > 50
     assert verdict["counts"]["churn_tasks_ok"] > 10
     assert verdict["counts"]["trainer_epochs_ok"] >= 1
+    assert verdict["counts"]["scale_tasks_ok"] >= 1
     # replay contract, re-checked from the artifacts: live JSONL ==
     # dry-run regeneration from the same (seed, duration)
     live = fault_log_digest(os.path.join(str(tmp_path),
