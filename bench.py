@@ -1066,6 +1066,195 @@ def bench_data():
     return out
 
 
+def bench_objects():
+    """Object plane (docs/object_plane.md): tree-broadcast time
+    1 -> N consumers vs N sequential single-peer pulls, restart-storm
+    re-distribution time (half the holders die, fresh consumers
+    re-pull through failover), and stage-to-stage bytes/s through the
+    PullManager vs the flat single-source wire client.
+
+    In-process node harness (store + pull engine + object server per
+    simulated node) over loopback TCP. Loopback has no per-link
+    bandwidth, which is the whole variable broadcast fan-out exists to
+    manage — so the broadcast/sequential comparison runs under a fixed
+    per-chunk service time on every serving node (LINK_S below, the
+    modeled cost of a constrained peer link). The sequential baseline
+    pays that cost serially, chunk after chunk after consumer after
+    consumer; the tree overlaps it across links. The stage-to-stage
+    section runs with NO link model — it measures the real path
+    overhead of the two clients doing identical work (wire pull into
+    a sealed local store object). Same-box modeled numbers: deltas
+    are same-session only, like the other runtime sections."""
+    import shutil
+    import tempfile
+    import threading
+
+    out = {}
+    tmp = tempfile.mkdtemp(prefix="rtpu-bench-objects-")
+    nodes = []
+    try:
+        from ray_tpu._private import wire_stats
+        from ray_tpu._private.config import get_config
+        from ray_tpu._private.ids import JobID, ObjectID, TaskID
+        from ray_tpu._private.object_store import ShmStore
+        from ray_tpu._private.object_transfer import (PeerClients,
+                                                      PullManager,
+                                                      pull_object,
+                                                      serve_store)
+        from ray_tpu._private.rpc import RpcClient, RpcServer
+
+        SIZE = 16 << 20
+        N = 8
+        LINK_S = 0.006          # modeled per-chunk link service time
+        get_config().apply_system_config(
+            {"object_chunk_size_bytes": 1 << 20})
+
+        class Node:
+            def __init__(self, name, link_s=0.0):
+                self.store = ShmStore(
+                    f"ob{os.getpid()}-{name}",
+                    capacity_bytes=256 << 20,
+                    spill_dir=os.path.join(tmp, name),
+                    spill_threshold=0.95)
+                self.peers = PeerClients()
+                self.pm = PullManager(self.store, self.peers,
+                                      label=name)
+                self.served = wire_stats.ChannelStats()
+                self.server = RpcServer(component=f"ob_{name}")
+
+                def view(oid_bytes):
+                    if link_s:
+                        time.sleep(link_s)
+                    return self.store.get_local(ObjectID(oid_bytes))
+
+                serve_store(self.server, view,
+                            progress=self.pm.progress,
+                            stats=self.served)
+                self.addr = tuple(self.server.address)
+                nodes.append(self)
+
+            def close(self):
+                self.peers.close()
+                self.server.shutdown()
+                self.store.shutdown()
+
+        task = TaskID.for_normal_task(JobID.from_int(9))  # random bits
+
+        def oid(i):
+            return ObjectID.from_index(task, i)
+
+        payload = os.urandom(SIZE)
+        root = Node("root", link_s=LINK_S)
+        root.store.put_blob(oid(1), payload)
+
+        # -- N sequential single-peer pulls (the pre-broadcast shape:
+        # every consumer drains the one holder's link, one at a time)
+        seq = [Node(f"s{i}", link_s=LINK_S) for i in range(N)]
+        t0 = time.perf_counter()
+        for node in seq:
+            node.pm.pull(oid(1).binary(), SIZE, (root.addr,))
+        dt_seq = time.perf_counter() - t0
+
+        # -- tree broadcast: N fresh consumers, binary tree over
+        # (parent, root-fallback) source lists, all pulls concurrent;
+        # parents re-serve chunks while their own pull is in flight
+        tree = [Node(f"t{i}", link_s=LINK_S) for i in range(N)]
+
+        def wait_pulling(node, oid_b, deadline=30.0):
+            end = time.perf_counter() + deadline
+            while time.perf_counter() < end:
+                if node.store.contains(ObjectID(oid_b)) \
+                        or node.pm.progress(oid_b, 0, 0) is not None:
+                    return
+                time.sleep(0.001)
+
+        root_bytes0 = root.served.bytes
+        threads = []
+        t0 = time.perf_counter()
+        for k, node in enumerate(tree):
+            parent = root if k == 0 else tree[(k - 1) // 2]
+            if parent is not root:
+                wait_pulling(parent, oid(1).binary())
+            th = threading.Thread(
+                target=node.pm.pull,
+                args=(oid(1).binary(), SIZE, (parent.addr, root.addr)))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=120)
+        dt_tree = time.perf_counter() - t0
+        out["object_broadcast_gbps"] = round(
+            N * SIZE * 8 / dt_tree / 1e9, 2)
+        out["object_broadcast_seq_gbps"] = round(
+            N * SIZE * 8 / dt_seq / 1e9, 2)
+        out["object_broadcast_vs_sequential"] = round(
+            dt_seq / dt_tree, 2)
+        out["object_link_model_ms_per_chunk"] = LINK_S * 1e3
+        # of the 8 delivered copies, the fraction the ROOT's link
+        # carried during the broadcast (1/N = perfect fan-out)
+        out["object_broadcast_root_bytes_fraction"] = round(
+            (root.served.bytes - root_bytes0) / (N * SIZE), 3)
+
+        # -- restart storm: half the sealed holders die; fresh
+        # consumers listing a corpse FIRST must fail over and re-seal
+        dead, live = tree[:N // 2], tree[N // 2:]
+        for node in dead:
+            node.server.shutdown()
+        storm = [Node(f"r{i}") for i in range(N // 2)]
+        threads = []
+        t0 = time.perf_counter()
+        for i, node in enumerate(storm):
+            srcs = (dead[i].addr, live[i].addr, root.addr)
+            th = threading.Thread(target=node.pm.pull,
+                                  args=(oid(1).binary(), SIZE, srcs))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=120)
+        out["object_restart_storm_redistribute_s"] = round(
+            time.perf_counter() - t0, 3)
+
+        # -- stage-to-stage blocks, NO link model: the flat
+        # single-source client (the pre-PullManager localization path:
+        # wire pull into bytes, then a second copy into the store) vs
+        # the pull engine writing chunks straight into the unsealed
+        # shm segment. Both end with the block sealed locally.
+        BLOCK, NBLOCKS = 4 << 20, 16
+        stage_src = Node("stagesrc")
+        for i in range(NBLOCKS):
+            stage_src.store.put_blob(oid(10 + i), os.urandom(BLOCK))
+        flat_sink = Node("flatsink")
+        flat_client = RpcClient(stage_src.addr)
+        t0 = time.perf_counter()
+        for i in range(NBLOCKS):
+            data = pull_object(flat_client, oid(10 + i).binary(),
+                               BLOCK)
+            flat_sink.store.put_blob(oid(10 + i), data)
+        dt_flat = time.perf_counter() - t0
+        flat_client.close()
+        pm_sink = Node("pmsink")
+        t0 = time.perf_counter()
+        for i in range(NBLOCKS):
+            pm_sink.pm.pull(oid(10 + i).binary(), BLOCK,
+                            (stage_src.addr,))
+        dt_pm = time.perf_counter() - t0
+        out["object_stage_bytes_per_sec"] = int(
+            NBLOCKS * BLOCK / dt_pm)
+        out["object_stage_bytes_per_sec_flat"] = int(
+            NBLOCKS * BLOCK / dt_flat)
+        out["object_stage_vs_flat"] = round(dt_flat / dt_pm, 2)
+    except Exception as e:
+        print(f"# objects bench failed: {e!r}", file=sys.stderr)
+    finally:
+        for node in nodes:
+            try:
+                node.close()
+            except Exception:
+                pass    # teardown best effort
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def bench_model_mfu():
     """Flagship-transformer training-step time and MFU% on the real
     chip. K steps run inside ONE jitted lax.scan (with the state
@@ -1260,6 +1449,7 @@ def main():
     record.update(_run_section_subprocess("--serve"))
     record.update(_run_section_subprocess("--multislice"))
     record.update(_run_section_subprocess("--data"))
+    record.update(_run_section_subprocess("--objects"))
     record.update(bench_model_mfu())
     print(json.dumps(record))
     print(f"# scheduled {n_scheduled} of {N_TASKS} pending; "
@@ -1280,5 +1470,7 @@ if __name__ == "__main__":
         print(json.dumps(bench_multislice()))
     elif "--data" in sys.argv:
         print(json.dumps(bench_data()))
+    elif "--objects" in sys.argv:
+        print(json.dumps(bench_objects()))
     else:
         main()

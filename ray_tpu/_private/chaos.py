@@ -41,7 +41,15 @@ A **rule** names an event and an action::
   stretches the boot by the rule's seconds instead of stalling the
   reconciler — and ``autoscaler.provider.boot`` — ``kill`` makes the
   node boot and immediately die, the boot-then-die preemption
-  analog, WITHOUT exiting the driver process hosting the provider).
+  analog, WITHOUT exiting the driver process hosting the provider),
+  ``transfer`` (the object plane's pull engine:
+  ``object.transfer.fetch`` fires in the PULLING process before each
+  chunk RPC — ``drop`` discards the chunk attempt (a retry with
+  backoff), ``sever`` cuts the peer connection mid-pull (a reconnect
+  or re-route), ``delay`` stalls the chunk — and
+  ``object.transfer.seal`` fires just before a completed pull seals
+  into the local store — ``kill`` dies holding a full unsealed
+  buffer, the restart-storm mid-transfer death; docs/object_plane.md).
 - ``method``: the RPC method / push topic / task name at the event
   (``reply`` for reply frames; empty for lifecycle points).
 - ``action``: ``drop`` (frame vanishes), ``delay=SECONDS`` (stall),
@@ -115,7 +123,7 @@ KILL_EXIT_CODE = 42
 ACTIONS = ("drop", "delay", "dup", "sever", "kill", "pressure")
 POINTS = ("send", "recv", "dispatch", "spawn", "teardown", "boot",
           "exec", "watchdog", "rendezvous", "checkpoint", "dcn",
-          "map", "provider", "*")
+          "map", "provider", "transfer", "*")
 
 _RULE_RE = re.compile(
     r"^(?P<component>[^.:\s]+)\.(?P<point>[^.:\s]+)\.(?P<method>[^:\s]*)"

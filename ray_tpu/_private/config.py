@@ -155,6 +155,30 @@ _D("object_store_fallback_directory", str, "",
    "Spill directory; empty = <session_dir>/spill.")
 _D("object_chunk_size_bytes", int, 5 * 1024 * 1024,
    "Chunk size for node-to-node object transfer.")
+_D("object_pull_deadline_s", float, 60.0,
+   "Total per-object pull budget: every chunk call, retry, backoff "
+   "sleep, and source re-route for one pull fits inside this window.")
+_D("object_pull_chunk_timeout_s", float, 10.0,
+   "Per-chunk RPC timeout inside a pull (clamped to the remaining "
+   "pull deadline).")
+_D("object_pull_retry_base_s", float, 0.05,
+   "Base delay of the pull retry backoff (exponential, seeded-jitter "
+   "via _private/backoff.py).")
+_D("object_pull_retry_cap_s", float, 2.0,
+   "Cap of the pull retry backoff.")
+_D("object_pull_max_inflight_bytes", int, 256 * 1024 * 1024,
+   "Per-process admission budget for concurrent in-flight pull "
+   "buffers: a restart storm of pulls queues here instead of "
+   "OOM-killing the node (oversized single objects admit alone).")
+_D("object_stripe_min_bytes", int, 32 * 1024 * 1024,
+   "Objects at or above this size stripe chunk ranges across all "
+   "sealed holders instead of pulling from one source.")
+_D("object_stripe_max_sources", int, 4,
+   "Maximum concurrent sources a striped pull fans in from.")
+_D("object_locality_min_bytes", int, 1024 * 1024,
+   "Scheduler locality hint threshold: tasks whose remote-located "
+   "args total at least this many bytes prefer the node holding "
+   "them (docs/object_plane.md).")
 
 # --- worker pool ---
 _D("worker_pool_prestart", int, 0, "Workers to pre-fork at init.")

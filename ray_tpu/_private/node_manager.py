@@ -275,7 +275,16 @@ class NodeManagerGroup:
         self._lock = threading.RLock()
         self._raylets: Dict[NodeID, Raylet] = {}  # guarded-by: _lock
         self._remote_nodes: Dict[NodeID, RemoteNodeHandle] = {}  # guarded-by: _lock
-        self._object_locations: Dict[ObjectID, NodeID] = {}  # guarded-by: _lock
+        # Multi-holder location table (docs/object_plane.md): every
+        # node known to hold a sealed copy, insertion-ordered (first =
+        # primary producer). Dead holders are filtered at read time.
+        self._object_locations: Dict[ObjectID, List[NodeID]] = {}  # guarded-by: _lock
+        # Broadcast fan-out assignments: consumer nodes recently handed
+        # a pull descriptor for the object, in tree order — consumer k
+        # pulls from consumer (k-1)//2 (falling back to real holders),
+        # so no single link serves more than ~2 subtrees. Advisory:
+        # wrong parents degrade to a re-route, never a wrong result.
+        self._pull_assignments: Dict[ObjectID, List[NodeID]] = {}  # guarded-by: _lock
         self._waiting: Dict[TaskID, TaskSpec] = {}  # guarded-by: _lock
         # unbounded-ok: owner intake; nested submissions are bounded by
         # owner_max_pending_tasks (shed with BackpressureError), the
@@ -339,12 +348,24 @@ class NodeManagerGroup:
         # remote raylets pulling argument objects (every node, the head
         # included, is addressable on the transfer plane).
         from ray_tpu._private.object_transfer import (
-            PeerClients, serve_store)
+            PeerClients, PullManager, serve_store)
         from ray_tpu._private.rpc import RpcServer
         self.object_server = RpcServer()
-        serve_store(self.object_server, self._serve_object_view)
-        self.object_server_addr = self.object_server.address
         self._peer_clients = PeerClients()
+        # Driver-side pull engine: dedup + retried + re-routed pulls
+        # into the owner's store; the owner locates holders directly
+        # from its own table (docs/object_plane.md).
+        self.pull_manager = PullManager(
+            self._shm_store, self._peer_clients,
+            locate=self._live_holder_addrs, label="owner")
+        serve_store(self.object_server, self._serve_object_view,
+                    progress=self.pull_manager.progress)
+        # Location service for re-routing pullers whose sources died
+        # (the raylets' PullManager calls this on the owner).
+        self.object_server.register(
+            "object_locations",
+            lambda ctx, oid_b: self._live_holder_addrs(oid_b))
+        self.object_server_addr = self.object_server.address
 
         self.head_node_id = NodeID.from_random()
         self.add_node(self.head_node_id, driver_node_resources)
@@ -507,56 +528,138 @@ class NodeManagerGroup:
 
     def record_object_location(self, oid: ObjectID, node_id: NodeID) -> None:
         with self._lock:
-            self._object_locations[oid] = node_id
+            holders = self._object_locations.setdefault(oid, [])
+            if node_id not in holders:
+                holders.append(node_id)
+
+    def _live_holder_addrs(self, oid_or_bytes) -> List[Tuple[str, int]]:
+        """Transfer-plane addresses of every LIVE node holding a sealed
+        copy of the object — the ``object_locations`` RPC reply and the
+        re-route source list. The driver's own object server is
+        included when its store holds (or can materialize) a copy."""
+        oid = (oid_or_bytes if isinstance(oid_or_bytes, ObjectID)
+               else ObjectID(oid_or_bytes))
+        addrs: List[Tuple[str, int]] = []
+        with self._lock:
+            for node_id in self._object_locations.get(oid, ()):
+                handle = self._remote_nodes.get(node_id)
+                if handle is not None and handle.alive:
+                    addrs.append(tuple(handle.addr))
+        if self._shm_store.contains(oid):
+            addrs.append(tuple(self.object_server_addr))
+        return addrs
+
+    def _pull_sources_for(self, oid: ObjectID,
+                          dest_node: Optional[NodeID]
+                          ) -> Optional[List[Tuple[str, int]]]:
+        """Ordered source list for ``dest_node``'s pull of ``oid``:
+        its broadcast-tree parent first (a peer consumer that streams
+        chunks as it receives them), then the live sealed holders.
+        None when no live holder exists (callers route into
+        reconstruction). Parents are advisory — a dead or never-sealed
+        parent degrades to the holders / owner re-route, never to a
+        wrong result."""
+        holders = self._live_holder_addrs(oid)
+        if not holders:
+            return None
+        sources: List[Tuple[str, int]] = []
+        if dest_node is not None:
+            with self._lock:
+                assigned = self._pull_assignments.setdefault(oid, [])
+                try:
+                    k = assigned.index(dest_node)
+                except ValueError:
+                    k = len(assigned)
+                    assigned.append(dest_node)
+                    # Advisory table hygiene: one entry per object
+                    # under broadcast; cap total tracked objects.
+                    if len(self._pull_assignments) > 1024:
+                        self._pull_assignments.pop(
+                            next(iter(self._pull_assignments)))
+                if k > 0:
+                    parent = assigned[(k - 1) // 2]
+                    handle = self._remote_nodes.get(parent)
+                    if handle is not None and handle.alive:
+                        sources.append(tuple(handle.addr))
+        for addr in holders:
+            if addr not in sources:
+                sources.append(addr)
+        return sources
+
+    def _preferred_node_for(self, spec) -> Optional[NodeID]:
+        """Locality-aware placement hint: prefer the live node holding
+        the largest remote object argument (above
+        ``object_locality_min_bytes``) so the task's heaviest input
+        never crosses the wire. Falls back to the head node — the
+        pre-locality behavior — when args are inline, local, small, or
+        unready."""
+        min_bytes = get_config().object_locality_min_bytes
+        best_node: Optional[NodeID] = None
+        best_size = min_bytes - 1
+        for arg in spec.args:
+            if arg.object_id is None or arg.owner_addr is not None:
+                continue
+            try:
+                entry = self._memory_store.get(arg.object_id, timeout=0)
+            except TimeoutError:
+                continue
+            if entry.kind != "remote":
+                continue
+            loc_node, size = entry.data
+            if size <= best_size:
+                continue
+            with self._lock:
+                holders = [n for n in self._object_locations.get(
+                               arg.object_id, (loc_node,))
+                           if (h := self._remote_nodes.get(n)) is not None
+                           and h.alive]
+            if holders:
+                best_node, best_size = holders[0], size
+        return best_node if best_node is not None else self.head_node_id
 
     def fetch_remote_object(self, oid: ObjectID, node_id: NodeID,
                             size: int) -> Optional[bytes]:
-        """Pull an object's bytes from the node holding it. None when
-        the node is gone or no longer has the object (callers route
-        into lineage reconstruction)."""
-        from ray_tpu._private.object_transfer import (
-            ObjectLocationError, pull_object)
+        """Pull an object into the driver's store (via the PullManager:
+        deduped, retried, re-routed) and return its bytes. None when no
+        live node still serves it (callers route into lineage
+        reconstruction)."""
+        from ray_tpu.exceptions import ObjectTransferError
+        sources = self._live_holder_addrs(oid)
         with self._lock:
             handle = self._remote_nodes.get(node_id)
-        if handle is None or not handle.alive:
-            return None
+        if handle is not None and handle.alive \
+                and tuple(handle.addr) not in sources:
+            sources.insert(0, tuple(handle.addr))
         try:
-            return pull_object(self._peer_clients.get(handle.addr),
-                               oid.binary(), size)
-        except (ObjectLocationError, ConnectionError, OSError, TimeoutError):
+            self.pull_manager.pull(oid.binary(), size, sources)
+        except ObjectTransferError:
             return None
+        view = self._shm_store.get_local(oid)
+        return None if view is None else bytes(view)
 
     def _localize_remote_entry(self, oid: ObjectID, entry) -> bool:
         """Pull a remote-located object into the driver's store and
         rewrite its directory entry to a local shm entry. False when
-        the holder is gone (callers route into reconstruction)."""
+        every holder is gone (callers route into reconstruction)."""
+        from ray_tpu.exceptions import ObjectTransferError
         loc_node, size = entry.data
         if not self._shm_store.contains(oid):
-            blob = self.fetch_remote_object(oid, loc_node, size)
-            if blob is None:
-                return False
+            sources = self._live_holder_addrs(oid)
+            with self._lock:
+                handle = self._remote_nodes.get(loc_node)
+            if handle is not None and handle.alive \
+                    and tuple(handle.addr) not in sources:
+                sources.insert(0, tuple(handle.addr))
             try:
-                self._shm_store.put_blob(oid, blob)
-            except ValueError:
-                pass          # raced another localization
+                self.pull_manager.pull(oid.binary(), size, sources)
+            except ObjectTransferError:
+                return False
         info = self._shm_store.segment_for(oid)
         if info is None:
             return False
         entry.kind = "shm"
         entry.data = info
         return True
-
-    def _node_addr_for_object(self, oid: ObjectID):
-        """Transfer-plane address serving ``oid``: the holder node's, or
-        the driver's own object server for locally-stored objects."""
-        with self._lock:
-            loc = self._object_locations.get(oid)
-            if loc is not None:
-                handle = self._remote_nodes.get(loc)
-                if handle is not None and handle.alive:
-                    return handle.addr
-                return None       # holder died: object lost
-        return self.object_server_addr
 
     def _handle_remote_build_error(self, handle: RemoteNodeHandle,
                                    spec: TaskSpec, err) -> None:
@@ -861,8 +964,10 @@ class NodeManagerGroup:
                               spec: TaskSpec,
                               batch_shipped: Optional[set] = None):
         """Args for a remote node: inline values travel as bytes;
-        object args travel as ("pull", oid, holder_addr, size) —
-        the raylet fetches them over the transfer plane.
+        object args travel as ("pull", oid, sources, size) — sources
+        is the ordered transfer-plane address list (broadcast-tree
+        parent first, then sealed holders; docs/object_plane.md) the
+        raylet's PullManager fetches through.
         ``batch_shipped``: fids whose blob an earlier payload of the
         SAME submit_many frame already carries — one copy per frame,
         not one per task (the raylet caches it pre-admission)."""
@@ -893,21 +998,24 @@ class NodeManagerGroup:
                 if info is None:
                     return None, _LostArgError(oid)
                 arg_descs.append(("pull", oid.binary(),
-                                  self.object_server_addr, info[1]))
+                                  (tuple(self.object_server_addr),),
+                                  info[1]))
                 continue
             if entry.kind == "remote":
                 loc_node, size = entry.data
-                addr = self._node_addr_for_object(oid)
-                if addr is None:
+                sources = self._pull_sources_for(oid, handle.node_id)
+                if sources is None:
                     return None, _LostArgError(oid)
-                arg_descs.append(("pull", oid.binary(), addr, size))
+                arg_descs.append(("pull", oid.binary(), tuple(sources),
+                                  size))
                 continue
             # shm in the driver store
             info = self._shm_store.segment_for(oid)
             if info is None:
                 return None, _LostArgError(oid)
             arg_descs.append(("pull", oid.binary(),
-                              self.object_server_addr, info[1]))
+                              (tuple(self.object_server_addr),),
+                              info[1]))
         payload = {
             "type": ("create_actor"
                      if spec.task_type == TaskType.ACTOR_CREATION_TASK
@@ -1434,15 +1542,18 @@ class NodeManagerGroup:
             if desc[0] == "shm":
                 _, oid_b, _name, size = desc
                 payload["args"][i] = ("pull", oid_b,
-                                      self.object_server_addr, size)
+                                      (tuple(self.object_server_addr),),
+                                      size)
             elif desc[0] == "remote":
                 _, oid_b, _node, size = desc
-                addr = self._node_addr_for_object(ObjectID(oid_b))
-                if addr is None:
+                sources = self._pull_sources_for(ObjectID(oid_b),
+                                                 handle.node_id)
+                if sources is None:
                     if self._recover_object_cb is not None:
                         self._recover_object_cb(ObjectID(oid_b))
                     return False
-                payload["args"][i] = ("pull", oid_b, addr, size)
+                payload["args"][i] = ("pull", oid_b, tuple(sources),
+                                      size)
         return True
 
     def cancel_queued(self, task_id: TaskID) -> bool:
@@ -1918,7 +2029,7 @@ class NodeManagerGroup:
             if req is None:
                 req = SchedulingRequest(
                     demand=spec.resources,
-                    preferred_node=self.head_node_id,
+                    preferred_node=self._preferred_node_for(spec),
                     strategy=spec.scheduling_strategy,
                 )
                 spec._sched_request = req   # type: ignore[attr-defined]

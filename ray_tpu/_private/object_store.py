@@ -143,12 +143,26 @@ class ShmStore:
     # lock-held: _lock
     def _close_or_defer(self, seg: shared_memory.SharedMemory) -> None:
         """Close a segment's mapping; if zero-copy views still alias it
-        (BufferError: exported pointers), defer — the unlinked mapping
-        stays valid until the last reader view dies, which is exactly
-        the pin-until-released semantics readers rely on."""
+        (BufferError: exported pointers), orphan it — our references to
+        the mapping are dropped so the last reader view keeps the mmap
+        alive and its dealloc unmaps silently, which is exactly the
+        pin-until-released semantics readers rely on. Orphaning (rather
+        than keeping the segment open for a later retry) also makes the
+        eventual ``SharedMemory.__del__`` a no-op: a close() re-raising
+        BufferError during interpreter teardown is an unraisable
+        warning we can never order around."""
         try:
             seg.close()
         except BufferError:
+            seg._buf = None
+            seg._mmap = None  # reader views hold their own mmap refs
+            fd = getattr(seg, "_fd", -1)
+            if fd >= 0:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass  # swallow-ok: fd already closed elsewhere
+                seg._fd = -1
             self._zombies.append(seg)
 
     def _drain_zombies(self) -> None:  # lock-held: _lock
@@ -185,6 +199,40 @@ class ShmStore:
         buf = self.create(object_id, len(blob))
         buf[:] = blob
         self.seal(object_id)
+
+    def begin_create(self, object_id: ObjectID,
+                     size: int) -> Optional[memoryview]:
+        """``create`` for the pull plane: returns None when the object
+        is already sealed (or spilled) here — the caller's exactly-once
+        seal fast path — and reclaims a stale same-name segment left by
+        a previous incarnation of this node (a chaos kill between
+        create and seal) instead of failing."""
+        try:
+            return self.create(object_id, size)
+        except ValueError:
+            if self.contains(object_id):
+                return None
+            # unsealed leftover in THIS process (an aborted pull that
+            # raced us): free it and take over
+            self.free(object_id)
+            return self.create(object_id, size)
+        except FileExistsError:
+            # segment on disk but unknown to this store: a previous
+            # incarnation died between create and seal
+            seg = attach_segment(_segment_name(self._session, object_id))
+            try:
+                seg.unlink()
+            finally:
+                seg.close()
+            return self.create(object_id, size)
+
+    def abort_create(self, object_id: ObjectID) -> None:
+        """Free a created-but-unsealed segment (a failed pull). Sealed
+        objects are left alone — aborting is only legal on the create
+        the caller itself began."""
+        with self._lock:
+            if object_id not in self._sealed:
+                self._free_locked(object_id)
 
     def adopt(self, object_id: ObjectID, size: int) -> None:
         """Take ownership of a segment a worker process already created

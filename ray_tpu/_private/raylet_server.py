@@ -43,11 +43,12 @@ from ray_tpu._private.gcs_client import GcsClient
 from ray_tpu._private.ids import ActorID, NodeID, ObjectID
 from ray_tpu._private.object_store import ShmStore, _segment_name
 from ray_tpu._private.object_transfer import (
-    ObjectLocationError,
     PeerClients,
-    pull_object,
+    PullManager,
+    pull_counters,
     serve_store,
 )
+from ray_tpu.exceptions import ObjectTransferError
 from ray_tpu._private.rpc import ConnectionContext, RpcServer
 from ray_tpu._private.worker_pool import BaseWorker, ProcessWorker, WorkerPool
 
@@ -171,7 +172,13 @@ class RayletServer:
 
         self.server = RpcServer(component="raylet")
         self.address = self.server.address
-        serve_store(self.server, self._object_view, self._free_object)
+        # Pull plane: deduped, deadline-budgeted, re-routed fetches
+        # (docs/object_plane.md). progress= lets this raylet re-serve
+        # chunks of an in-flight pull to its broadcast-tree children.
+        self.pull_manager = PullManager(self.shm_store, self._peers,
+                                        label="raylet")
+        serve_store(self.server, self._object_view, self._free_object,
+                    progress=self.pull_manager.progress)
         self.server.register("ping", lambda ctx: "pong")
         self.server.register("register_owner", self._register_owner)
         self.server.register("stats", lambda ctx: self.stats())
@@ -759,7 +766,7 @@ class RayletServer:
                        actor: bool = False) -> None:
         try:
             self._localize_args(payload)
-        except ObjectLocationError as e:
+        except ObjectTransferError as e:
             if not actor:
                 self.worker_pool.push_worker(worker)
             self._push_owner_buffered("task_done", {
@@ -799,34 +806,28 @@ class RayletServer:
                 ctx=self._ctx_for_task(payload["task_id"], pop=True))
 
     def _localize_args(self, payload: dict) -> None:
-        """Rewrite ("pull", oid, addr, size) arg descriptors into local
-        ("shm", ...) ones, fetching missing objects from peers."""
+        """Rewrite ("pull", oid, sources, size) arg descriptors into
+        local ("shm", ...) ones, fetching missing objects through the
+        PullManager: concurrent tasks needing the same object share ONE
+        wire fetch, chunk calls are deadline-budgeted, and a dead
+        source re-routes to the next holder (falling back to the
+        owner's location table via ``owner_addr``). Raises only the
+        typed ObjectTransferError taxonomy."""
         args = payload["args"]
+        owner_addr = payload.get("owner_addr")
         for i, desc in enumerate(args):
             if desc[0] != "pull":
                 continue
-            _, oid_bytes, addr, size = desc
+            _, oid_bytes, sources, size = desc
             oid = ObjectID(oid_bytes)
-            if not self.shm_store.contains(oid):
-                client = self._peers.get(tuple(addr))
-                try:
-                    blob = pull_object(client, oid_bytes, size)
-                except (ConnectionError, OSError) as e:
-                    err = ObjectLocationError(str(e))
-                    err.oid_bytes = oid_bytes
-                    raise err
-                except ObjectLocationError as e:
-                    e.oid_bytes = oid_bytes
-                    raise
-                try:
-                    self.shm_store.put_blob(oid, blob)
-                except ValueError:
-                    pass      # raced another pull of the same object
+            if self.pull_manager.pull(oid_bytes, size, sources,
+                                      owner_addr=owner_addr):
                 self.num_pulled += 1
             info = self.shm_store.segment_for(oid)
             if info is None:
-                err = ObjectLocationError(
-                    f"object {oid} evicted during localization")
+                err = ObjectTransferError(
+                    f"object {oid} evicted during localization",
+                    object_id_hex=oid.hex())
                 err.oid_bytes = oid_bytes
                 raise err
             args[i] = ("shm", oid_bytes, info[0], info[1])
@@ -1080,6 +1081,10 @@ class RayletServer:
                 "dedupe_hit_rate": (self.server.dedupe_hits / idem
                                     if idem else 0.0),
                 "wire": wire_stats.snapshot(),
+                # Pull-plane state counters: the driver sums these
+                # across nodes into ray_tpu_object_pulls{state}
+                # (docs/object_plane.md).
+                "pulls": pull_counters(),
             }
 
     # -- memory watchdog -----------------------------------------------

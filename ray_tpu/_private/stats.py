@@ -93,6 +93,14 @@ def install_runtime_metrics() -> None:
         "Idempotency dedupe-cache hit rate across raylet rpc "
         "servers (heartbeat-reported; >0 means retries/duplicate "
         "frames were collapsed)")
+    object_pulls = m.Gauge(
+        "ray_tpu_object_pulls",
+        "Pull-plane transfers by outcome across the cluster "
+        "(docs/object_plane.md): started (wire fetches driven), "
+        "deduped (readers attached to an in-flight fetch), rerouted "
+        "(source failover / owner re-route), striped (multi-source "
+        "range fan-in), failed (typed terminal errors)",
+        tag_keys=("state",))
     serve_rps = m.Gauge(
         "ray_tpu_serve_rps",
         "Serve-plane requests/s accepted by this process's routers "
@@ -241,10 +249,14 @@ def install_runtime_metrics() -> None:
         from ray_tpu._private import wire_stats
         merged: dict = {name: dict(snap)
                         for name, snap in wire_stats.snapshot().items()}
+        from ray_tpu._private import object_transfer
+        pulls = dict(object_transfer.pull_counters())  # driver's engine
         dedupe_hits = dedupe_calls = 0
         for _nid, (_ts, nstats) in list(w.node_stats.items()):
             dedupe_hits += nstats.get("dedupe_hits", 0)
             dedupe_calls += nstats.get("dedupe_calls", 0)
+            for state, count in (nstats.get("pulls") or {}).items():
+                pulls[state] = pulls.get(state, 0) + count
             wire = nstats.get("wire")
             if not isinstance(wire, dict):
                 continue
@@ -266,6 +278,8 @@ def install_runtime_metrics() -> None:
         rpc_fastframe.set(fastframe_hits)
         rpc_dedupe_rate.set(dedupe_hits / dedupe_calls
                             if dedupe_calls else 0.0)
+        for state, count in pulls.items():
+            object_pulls.set(count, tags={"state": state})
         # serve plane (docs/serve.md §Observability): RPS over the
         # scrape window, live queue depth + replica count per
         # deployment, realized batch coalescing factor

@@ -122,6 +122,54 @@ class OwnerDiedError(ObjectLostError):
     """The owner of this object died; ownership is not replicated."""
 
 
+class ObjectTransferError(RayTpuError):
+    """Base of the transfer-plane taxonomy (docs/object_plane.md): a
+    chunked node-to-node pull failed. Replaces the old untyped
+    ``ObjectLocationError``. Carries:
+
+    - ``object_id_hex``: the object being transferred;
+    - ``offset``: byte offset reached when the transfer failed (-1 =
+      before the first chunk);
+    - ``retryable``: always True by contract — a failed pull sealed
+      nothing, so re-pulling (from another source) or lineage
+      reconstruction is always safe.
+
+    Raised inside tasks it surfaces TYPED at the caller's ``get()``
+    (``_typed_across_tasks``); the owner's recovery path treats it as
+    a reconstruction trigger, never a task bug."""
+
+    retryable = True
+    _typed_across_tasks = True
+
+    def __init__(self, msg: str = "object transfer failed",
+                 object_id_hex: str = "", offset: int = -1):
+        super().__init__(msg)
+        self.object_id_hex = object_id_hex
+        self.offset = int(offset)
+
+    def __reduce__(self):
+        # type(self): subclasses inherit this __init__/signature, so
+        # they must unpickle as themselves — the error crosses task
+        # and RPC boundaries and `except ObjectSourceLostError` must
+        # keep working on the far side.
+        return (type(self), (self.args[0] if self.args else "",
+                             self.object_id_hex, self.offset))
+
+
+class ObjectSourceLostError(ObjectTransferError):
+    """Every known holder of the object is gone (died, or freed the
+    object between chunks). The owner routes this into lineage
+    reconstruction; mid-broadcast it triggers a re-route to live
+    holders via the owner's location table."""
+
+
+class ObjectTransferTimeoutError(ObjectTransferError):
+    """The pull's deadline budget elapsed across all sources and
+    retries. Distinct from source loss: holders may still exist, the
+    transfer just could not complete in budget (congestion, chaos
+    delay) — callers may re-issue with a fresh budget."""
+
+
 class TaskCancelledError(RayTpuError):
     pass
 

@@ -37,9 +37,9 @@ from ray_tpu.soak import oracle
 from ray_tpu.soak.schedule import (Schedule, fault_log_digest,
                                    generate_schedule)
 from ray_tpu.soak.workloads import (ChurnDriver, IngressDriver,
-                                    ScaleDriver, TrainerDriver,
-                                    build_serve_apps, serve_chaos_arm,
-                                    serve_chaos_disarm)
+                                    ScaleDriver, StormDriver,
+                                    TrainerDriver, build_serve_apps,
+                                    serve_chaos_arm, serve_chaos_disarm)
 
 
 @dataclasses.dataclass
@@ -91,7 +91,7 @@ class SoakRunner:
         chaos.log_event(self.schedule.header_record())
 
         cluster = None
-        ingress = trainer = churn = scale = None
+        ingress = trainer = churn = scale = storm = None
         try:
             cluster = self._bring_up()
             # trainer first: its two slice workers claim head pool
@@ -106,13 +106,22 @@ class SoakRunner:
             # the autoscaling lane: ELASTIC bursts that only complete
             # if the v2 scaler supplies (and later drains) capacity
             scale = ScaleDriver(cluster).start()
+            # the broadcast lane: 8 concurrent consumers of one fresh
+            # remote object per cycle (pull dedup + storm-scope chaos)
+            storm = StormDriver().start()
 
             time.sleep(cfg.warmup_s)        # calm p99 baseline window
             ingress.calm = False
             self._run_phases(ingress, trainer, churn, deployments)
-            return self._finish(ingress, trainer, churn, scale,
+            return self._finish(ingress, trainer, churn, scale, storm,
                                 deployments)
         finally:
+            if storm is not None:
+                try:
+                    storm.stop()
+                    storm.join(timeout=120)
+                except Exception:
+                    pass    # teardown best effort
             if scale is not None:
                 try:
                     scale.stop()
@@ -196,10 +205,13 @@ class SoakRunner:
         thunk. Arm failures degrade to a no-op phase (recorded in the
         timeline either way — the digest is about the SCHEDULE, not
         about every fault landing)."""
-        if ph.scope in ("driver", "autoscaler"):
+        if ph.scope in ("driver", "autoscaler", "storm"):
             # autoscaler-scope provider points are site-applied in the
-            # driver process (FakeCloudProvider lives here), so the
-            # same install_phase route reaches them
+            # driver process (FakeCloudProvider lives here) and
+            # storm-scope transfer points fire in the pulling process
+            # (the StormDriver's consumers pull through the driver's
+            # PullManager), so the same install_phase route reaches
+            # all three
             chaos.install_phase(ph.name, ph.rules)
             return lambda: chaos.clear_phase(ph.name)
         if ph.scope == "churn":
@@ -233,10 +245,12 @@ class SoakRunner:
 
     # -- verdict ------------------------------------------------------
 
-    def _finish(self, ingress, trainer, churn, scale,
+    def _finish(self, ingress, trainer, churn, scale, storm,
                 deployments) -> oracle.SoakVerdict:
         cfg = self.cfg
         ingress.stop()
+        storm.stop()
+        storm.join(timeout=120)     # an in-flight broadcast rides out
         churn.stop()
         churn.join(timeout=60)
         churn.sweep()
@@ -255,7 +269,8 @@ class SoakRunner:
         inv: List[oracle.InvariantResult] = []
 
         lost = (list(ingress.lost) + list(churn.lost)
-                + list(trainer.failures) + list(scale.lost))
+                + list(trainer.failures) + list(scale.lost)
+                + list(storm.lost))
         inv.append(oracle.InvariantResult(
             "no-lost-results", not lost,
             "; ".join(lost[:5]) + (" …" if len(lost) > 5 else "")))
@@ -292,7 +307,7 @@ class SoakRunner:
                                     f"schedule {want[:12]}"))
 
         counts: Dict[str, float] = {}
-        for drv in (ingress, trainer, churn, scale):
+        for drv in (ingress, trainer, churn, scale, storm):
             counts.update(drv.stats())
         counts["fires"] = self._count_fires()
         counts["phases"] = len(self.schedule.phases)

@@ -27,6 +27,10 @@ A **schedule** is a deterministic function of ``(seed, duration)``:
   ``autoscaler``  ``chaos.install_phase()`` in the driver, like
               ``driver`` — the FakeCloudProvider's site-applied
               ``provider`` points live in the driver process
+  ``storm``   ``chaos.install_phase()`` in the driver, like
+              ``driver`` — ``object.transfer.fetch`` fires in the
+              pulling process, and the StormDriver's broadcast
+              consumers pull through the driver's PullManager
   ==========  =====================================================
 
 The **weight table** below is the draw distribution. Every entry
@@ -53,7 +57,7 @@ import json
 import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
-SCHEDULE_VERSION = 2   # v2: autoscaler scope (provider chaos)
+SCHEDULE_VERSION = 3   # v3: storm scope (object pull-plane chaos)
 
 # record kinds covered by the replay digest (logical timeline only)
 DIGEST_KINDS = frozenset({"schedule", "arm", "disarm"})
@@ -117,6 +121,19 @@ WEIGHTS: Tuple[ArmSpec, ...] = (
             "autoscaler", 1.0),
     ArmSpec("autoscaler.provider.boot",
             "autoscaler.provider.boot:kill@{after}", "autoscaler", 1.0),
+    # -- storm scope: object pull-plane faults. Armed via
+    # install_phase in the driver — chaos on object.transfer.fetch
+    # fires in the PULLING process, and the StormDriver's 8-consumer
+    # broadcast pulls run in the driver's PullManager. Drops and
+    # severs must converge through the seeded-backoff retry/failover
+    # path with every consumer still sealing byte-identical copies
+    # (docs/object_plane.md).
+    ArmSpec("object.transfer.fetch",
+            "object.transfer.fetch:drop@{after}x2", "storm", 2.0),
+    ArmSpec("object.transfer.fetch",
+            "object.transfer.fetch:delay=0.05@{after}x3", "storm", 1.0),
+    ArmSpec("object.transfer.fetch",
+            "object.transfer.fetch:sever@{after}", "storm", 1.0),
 )
 
 # boot-scope pool: armed once in the remote raylet's environment at

@@ -793,6 +793,105 @@ class ChurnDriver(threading.Thread):
 
 
 # ---------------------------------------------------------------------------
+# restart-storm broadcast lane
+
+
+@ray_tpu.remote(num_cpus=0, resources={"CHURN": 0.01}, max_retries=5)
+def storm_weights(cycle: int, n: int):
+    return np.full(n, float(cycle), dtype=np.float64)
+
+
+class StormDriver(threading.Thread):
+    """The restart-storm broadcast lane (docs/object_plane.md): each
+    cycle creates a fresh multi-chunk weights object on the remote
+    node, then 8 driver-side consumers ``get()`` it CONCURRENTLY — one
+    wire fetch drives the transfer, the rest attach to it, so
+    ``ray_tpu_object_pulls{state="deduped"}`` must move over the run.
+    Storm-scope chaos (``object.transfer.fetch`` drop/delay/sever)
+    lands in this process's pull engine; the lane must ride it out
+    through the typed retry/failover path. Lost results: an UNTYPED
+    error surfacing from a pull, a consumer observing bytes that
+    differ from its peers (the broadcast's byte-identical-seals
+    contract), or a value off the analytic expectation."""
+
+    def __init__(self, consumers: int = 8, n_elems: int = 192_000):
+        super().__init__(daemon=True, name="soak-storm")
+        self.consumers = consumers
+        self.n_elems = n_elems      # * 8B ≈ 1.5MB: several wire chunks
+        self.bcasts_ok = 0
+        self.typed = 0
+        self.lost: List[str] = []
+        self._halt = threading.Event()
+
+    def start(self) -> "StormDriver":
+        super().start()
+        return self
+
+    def stop(self) -> None:
+        self._halt.set()
+
+    def run(self) -> None:
+        import hashlib
+        from ray_tpu.exceptions import RayTpuError
+        cycle = 0
+        while not self._halt.is_set():
+            cycle += 1
+            ref = storm_weights.remote(cycle, self.n_elems)
+            digests: List[Optional[str]] = [None] * self.consumers
+            errs: List[str] = []
+            lock = threading.Lock()
+
+            def consume(k, want_cycle=cycle, ref=ref):
+                try:
+                    arr = ray_tpu.get(ref, timeout=60)
+                    if (arr.shape != (self.n_elems,)
+                            or arr[0] != float(want_cycle)):
+                        with lock:
+                            errs.append(f"untyped: wrong value "
+                                        f"shape={arr.shape}")
+                        return
+                    digests[k] = hashlib.sha256(arr.tobytes()).hexdigest()
+                except RayTpuError:
+                    # the documented taxonomy surfacing at get() — a
+                    # legitimate outcome under chaos, never a loss
+                    with lock:
+                        errs.append("typed")
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errs.append(f"untyped: {e!r}")
+
+            threads = [threading.Thread(target=consume, args=(k,),
+                                        daemon=True,
+                                        name=f"soak-storm-c{k}")
+                       for k in range(self.consumers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=90)
+            untyped = [e for e in errs if e != "typed"]
+            self.typed += len(errs) - len(untyped)
+            got = [d for d in digests if d is not None]
+            if untyped:
+                self.lost.append(f"storm {cycle}: {untyped[0]}")
+            elif len(set(got)) > 1:
+                self.lost.append(
+                    f"storm {cycle}: consumers sealed divergent bytes")
+            elif got:
+                self.bcasts_ok += 1
+            self._halt.wait(0.2)
+
+    def stats(self) -> Dict[str, float]:
+        from ray_tpu._private.object_transfer import pull_counters
+        counters = pull_counters()      # driver-process pull engine
+        return {"storm_bcasts_ok": self.bcasts_ok,
+                "storm_typed": self.typed,
+                "storm_pulls_started": counters["started"],
+                "storm_pulls_deduped": counters["deduped"],
+                "storm_pulls_rerouted": counters["rerouted"],
+                "storm_lost": len(self.lost)}
+
+
+# ---------------------------------------------------------------------------
 # autoscaling lane
 
 

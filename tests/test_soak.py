@@ -1,8 +1,9 @@
 """The soak plane: seeded schedule replay (satellite: same seed ⇒
 byte-identical fault timeline), the invariant-oracle primitives, and
 the tier-1 composed smoke — the full mixed workload (ingress + 2-slice
-trainer + churn) under a seeded chaos schedule, sanitized, with every
-invariant asserted from the emitted verdict.
+trainer + churn + elastic bursts + 8-consumer broadcast storms) under
+a seeded chaos schedule, sanitized, with every invariant asserted from
+the emitted verdict.
 """
 
 import json
@@ -20,11 +21,11 @@ from ray_tpu.soak.schedule import (DIGEST_KINDS, fault_log_digest,
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # The smoke's pinned draw: at duration 14 this seed's schedule covers
-# all five live scopes (churn, serve, driver, trainer, autoscaler) —
-# verified by test_smoke_seed_covers_every_scope so a weight-table
-# edit that breaks the property fails loudly instead of silently
-# shrinking coverage.
-SMOKE_SEED = 63
+# all six live scopes (churn, serve, driver, trainer, autoscaler,
+# storm) — verified by test_smoke_seed_covers_every_scope so a
+# weight-table edit that breaks the property fails loudly instead of
+# silently shrinking coverage.
+SMOKE_SEED = 600
 SMOKE_DURATION = 14.0
 
 
@@ -80,7 +81,7 @@ def test_every_drawable_rule_parses_and_scopes_are_valid():
         assert sched.phases[0].scope == "churn"     # anchor phase
         for ph in sched.phases:
             assert ph.scope in ("driver", "churn", "serve",
-                                "trainer", "autoscaler")
+                                "trainer", "autoscaler", "storm")
             for rule in ph.rules:
                 ChaosRule.parse(rule)
 
@@ -89,7 +90,7 @@ def test_smoke_seed_covers_every_scope():
     scopes = {ph.scope for ph in
               generate_schedule(SMOKE_SEED, SMOKE_DURATION).phases}
     assert scopes == {"churn", "serve", "driver", "trainer",
-                      "autoscaler"}
+                      "autoscaler", "storm"}
 
 
 def test_cli_dry_run_prints_timeline_and_digest(tmp_path):
@@ -211,6 +212,11 @@ def test_soak_smoke_all_invariants_hold(tmp_path):
     assert verdict["counts"]["churn_tasks_ok"] > 10
     assert verdict["counts"]["trainer_epochs_ok"] >= 1
     assert verdict["counts"]["scale_tasks_ok"] >= 1
+    # the restart-storm lane: 8-consumer broadcasts sealed
+    # byte-identical, and pull dedup collapsed the concurrent reads
+    # onto in-flight fetches (docs/object_plane.md)
+    assert verdict["counts"]["storm_bcasts_ok"] >= 1
+    assert verdict["counts"]["storm_pulls_deduped"] >= 1
     # replay contract, re-checked from the artifacts: live JSONL ==
     # dry-run regeneration from the same (seed, duration)
     live = fault_log_digest(os.path.join(str(tmp_path),
